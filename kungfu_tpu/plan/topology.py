@@ -1,0 +1,113 @@
+"""Communication-topology generators.
+
+Each generator yields broadcast graphs (and, via `gen_default_reduce_graph`,
+their matching reduce graphs = reverse + self-loops). The host-aware shapes
+(tree, binary-tree-star, multi-binary-tree-star) put one "master" rank per
+host so cross-host traffic only flows between masters — the same
+locality trick the reference uses for its TCP all-reduce
+(reference: srcs/go/plan/topology.go:15-113). In the TPU build these feed the
+DCN control plane's CPU collectives; ICI data-plane collectives are compiled
+by XLA and need no explicit graphs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .graph import Graph
+from .peerlist import PeerList
+
+
+def _local_masters(peers: PeerList) -> Tuple[List[int], Dict[int, int]]:
+    """First rank seen on each host, in rank order; and host -> master map."""
+    masters: List[int] = []
+    host_master: Dict[int, int] = {}
+    for rank, p in enumerate(peers):
+        if p.ipv4 not in host_master:
+            host_master[p.ipv4] = rank
+            masters.append(rank)
+    return masters, host_master
+
+
+def gen_star_bcast_graph(k: int, root: int) -> Graph:
+    """Star centered at `root`: root sends to everyone directly."""
+    g = Graph(k)
+    for i in range(k):
+        if i != root:
+            g.add_edge(root, i)
+    return g
+
+
+def gen_tree(peers: PeerList) -> Graph:
+    """Two-level tree: host masters form a star under rank of first host;
+    each master fans out to its local peers."""
+    g = Graph(len(peers))
+    masters, host_master = _local_masters(peers)
+    for rank, p in enumerate(peers):
+        if host_master[p.ipv4] != rank:
+            g.add_edge(host_master[p.ipv4], rank)
+    for m in masters[1:]:
+        g.add_edge(masters[0], m)
+    return g
+
+
+def gen_binary_tree(k: int) -> Graph:
+    """Heap-shaped binary tree over ranks 0..k-1."""
+    g = Graph(k)
+    for i in range(k):
+        for j in (2 * i + 1, 2 * i + 2):
+            if j < k:
+                g.add_edge(i, j)
+    return g
+
+
+def _binary_tree_star(peers: PeerList, offset: int) -> Graph:
+    g = Graph(len(peers))
+    masters, host_master = _local_masters(peers)
+    for rank, p in enumerate(peers):
+        if host_master[p.ipv4] != rank:
+            g.add_edge(host_master[p.ipv4], rank)
+    k = len(masters)
+    if k > 1:
+        for i in range(k):
+            for j in (2 * i + 1, 2 * i + 2):
+                if j < k:
+                    g.add_edge(masters[(i + offset) % k], masters[(j + offset) % k])
+    return g
+
+
+def gen_binary_tree_star(peers: PeerList) -> Graph:
+    """Intra-host star + inter-host binary tree over masters."""
+    return _binary_tree_star(peers, 0)
+
+
+def gen_multi_binary_tree_star(peers: PeerList) -> List[Graph]:
+    """One rotated binary-tree-star per host master: multiple roots let
+    chunked traffic use every master's uplink concurrently."""
+    masters, _ = _local_masters(peers)
+    return [_binary_tree_star(peers, i) for i in range(len(masters))]
+
+
+def gen_circular_graph_pair(k: int, r: int) -> Tuple[Graph, Graph]:
+    """Ring (reduce, bcast) pair rotated to start at rank r.
+
+    The reduce graph carries partial sums around the ring ending at the
+    ring's last node; the bcast graph pushes the final value the rest of the
+    way around.
+    """
+    reduce_g = Graph(k)
+    for i in range(k):
+        reduce_g.add_edge(i, i)
+    bcast_g = Graph(k)
+    for i in range(1, k):
+        reduce_g.add_edge((r + i) % k, (r + i + 1) % k)
+        bcast_g.add_edge((r + i - 1) % k, (r + i) % k)
+    return reduce_g, bcast_g
+
+
+def gen_default_reduce_graph(bcast: Graph) -> Graph:
+    """Reduce graph matching a bcast graph: reversed edges + self-loops."""
+    g = bcast.reverse()
+    for i in range(g.n):
+        g.add_edge(i, i)
+    return g

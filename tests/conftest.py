@@ -1,0 +1,18 @@
+"""Test harness config.
+
+All JAX tests run on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) so multi-chip sharding logic
+is exercised without TPU hardware, mirroring the reference's single-machine
+multi-process emulation strategy (reference: scripts/tests/*).
+These env vars must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("KF_LOG_LEVEL", "warn")

@@ -1,0 +1,262 @@
+"""Unit tests for the cluster-plan layer.
+
+Covers the same ground as the reference's Go plan tests
+(reference: srcs/go/plan/*_test.go): identity codecs, rank/local-rank
+derivation, host-list generation, cluster validation + resize, and the
+topology generators' structural invariants.
+"""
+
+import pytest
+
+from kungfu_tpu.plan import (
+    Cluster,
+    Graph,
+    HostList,
+    PeerID,
+    PeerList,
+    PortRange,
+    even_partition,
+    format_ipv4,
+    gen_binary_tree,
+    gen_binary_tree_star,
+    gen_circular_graph_pair,
+    gen_default_reduce_graph,
+    gen_multi_binary_tree_star,
+    gen_star_bcast_graph,
+    gen_tree,
+    parse_ipv4,
+)
+
+
+def mk_peers(spec):
+    """spec like [('10.0.0.1', [p1, p2]), ...] -> PeerList"""
+    out = []
+    for host, ports in spec:
+        for p in ports:
+            out.append(PeerID.from_host(host, p))
+    return PeerList(out)
+
+
+class TestAddr:
+    def test_ipv4_roundtrip(self):
+        for s in ["127.0.0.1", "10.10.10.1", "255.255.255.255", "0.0.0.0"]:
+            assert format_ipv4(parse_ipv4(s)) == s
+
+    def test_ipv4_invalid(self):
+        for s in ["1.2.3", "1.2.3.4.5", "256.1.1.1", "a.b.c.d"]:
+            with pytest.raises(ValueError):
+                parse_ipv4(s)
+
+    def test_peer_id_roundtrip(self):
+        p = PeerID.parse("192.168.1.1:10002")
+        assert str(p) == "192.168.1.1:10002"
+        assert PeerID.from_bytes(p.to_bytes()) == p
+        assert len(p.to_bytes()) == 6
+
+    def test_colocated(self):
+        a = PeerID.parse("10.0.0.1:10000")
+        b = PeerID.parse("10.0.0.1:10001")
+        c = PeerID.parse("10.0.0.2:10000")
+        assert a.colocated_with(b)
+        assert not a.colocated_with(c)
+
+    def test_uid_distinguishes_restart(self):
+        p = PeerID.parse("10.0.0.1:10000")
+        assert p.uid(0) != p.uid(1)
+
+
+class TestPeerList:
+    def test_rank_and_local_rank(self):
+        pl = mk_peers([("10.0.0.1", [10000, 10001]), ("10.0.0.2", [10000, 10001])])
+        q = PeerID.parse("10.0.0.2:10001")
+        assert pl.rank(q) == 3
+        assert pl.local_rank(q) == 1
+        assert pl.local_size(q) == 2
+        assert pl.rank(PeerID.parse("9.9.9.9:1")) is None
+
+    def test_set_ops(self):
+        a = PeerList.parse("10.0.0.1:1,10.0.0.1:2,10.0.0.1:3")
+        b = PeerList.parse("10.0.0.1:2,10.0.0.1:3,10.0.0.1:4")
+        gone, new = a.diff(b)
+        assert str(gone) == "10.0.0.1:1"
+        assert str(new) == "10.0.0.1:4"
+        assert str(a.intersection(b)) == "10.0.0.1:2,10.0.0.1:3"
+        assert not a.disjoint(b)
+        assert a.disjoint(PeerList.parse("10.0.0.9:1"))
+
+    def test_bytes_digest_is_order_sensitive(self):
+        a = PeerList.parse("10.0.0.1:1,10.0.0.1:2")
+        b = PeerList.parse("10.0.0.1:2,10.0.0.1:1")
+        assert a.to_bytes() != b.to_bytes()
+
+    def test_parse_roundtrip(self):
+        s = "10.0.0.1:10000,10.0.0.2:10001"
+        assert str(PeerList.parse(s)) == s
+
+
+class TestHostList:
+    def test_parse_forms(self):
+        hl = HostList.parse("10.0.0.1,10.0.0.2:4,10.0.0.3:2:pub.example.com")
+        assert hl[0].slots == 1 and hl[0].public_addr == "10.0.0.1"
+        assert hl[1].slots == 4
+        assert hl[2].public_addr == "pub.example.com"
+        assert hl.cap == 7
+
+    def test_gen_peer_list_rank_order(self):
+        hl = HostList.parse("10.0.0.1:2,10.0.0.2:2")
+        pl = hl.gen_peer_list(3, PortRange(10000, 11000))
+        assert str(pl) == "10.0.0.1:10000,10.0.0.1:10001,10.0.0.2:10000"
+
+    def test_gen_peer_list_capacity(self):
+        hl = HostList.parse("10.0.0.1:2")
+        with pytest.raises(ValueError):
+            hl.gen_peer_list(3)
+
+    def test_gen_runner_list(self):
+        hl = HostList.parse("10.0.0.1:2,10.0.0.2:2")
+        rl = hl.gen_runner_list(38080)
+        assert str(rl) == "10.0.0.1:38080,10.0.0.2:38080"
+
+
+class TestCluster:
+    def mk(self, hosts="10.0.0.1:4,10.0.0.2:4", np=4):
+        hl = HostList.parse(hosts)
+        return Cluster(runners=hl.gen_runner_list(), workers=hl.gen_peer_list(np))
+
+    def test_validate_ok(self):
+        assert self.mk().validate() is None
+
+    def test_validate_missing_runner(self):
+        c = self.mk()
+        bad = Cluster(
+            runners=c.runners,
+            workers=PeerList([*c.workers, PeerID.parse("10.0.0.9:10000")]),
+        )
+        assert "missing runner" in bad.validate()
+
+    def test_validate_dup_port(self):
+        c = self.mk()
+        bad = Cluster(runners=c.runners, workers=PeerList([*c.workers, c.workers[0]]))
+        assert "duplicated port" in bad.validate()
+
+    def test_resize_shrink_truncates(self):
+        c = self.mk(np=4)
+        d = c.resize(2)
+        assert d.workers == PeerList(c.workers[:2])
+
+    def test_resize_grow_least_loaded(self):
+        c = self.mk(np=3)  # host1 has 2 workers, host2 has 1
+        d = c.resize(4)
+        assert len(d.workers) == 4
+        assert d.workers[3].host == "10.0.0.2"  # least loaded
+        assert d.validate() is None
+
+    def test_resize_grow_fresh_port(self):
+        c = self.mk(np=4)
+        d = c.resize(6)
+        assert d.validate() is None
+        assert len(set(d.workers)) == 6
+
+    def test_json_roundtrip(self):
+        c = self.mk()
+        assert Cluster.from_json(c.to_json()) == c
+
+    def test_digest_changes_on_resize(self):
+        c = self.mk(np=4)
+        assert c.to_bytes() != c.resize(5).to_bytes()
+
+
+def covers_all(bcast: Graph, root: int):
+    """Every node reachable from root — required for a valid broadcast."""
+    seen = {root}
+    stack = [root]
+    while stack:
+        i = stack.pop()
+        for j in bcast.nexts(i):
+            if j not in seen:
+                seen.add(j)
+                stack.append(j)
+    return len(seen) == bcast.n
+
+
+class TestTopology:
+    two_hosts = mk_peers([("10.0.0.1", [1, 2, 3]), ("10.0.0.2", [1, 2])])
+
+    def test_star(self):
+        g = gen_star_bcast_graph(4, 1)
+        assert sorted(g.nexts(1)) == [0, 2, 3]
+        assert covers_all(g, 1)
+
+    def test_tree_locality(self):
+        g = gen_tree(self.two_hosts)
+        # masters are ranks 0 and 3; only master->master crosses hosts
+        for i, j in g.edges():
+            cross = self.two_hosts[i].ipv4 != self.two_hosts[j].ipv4
+            if cross:
+                assert (i, j) == (0, 3)
+        assert covers_all(g, 0)
+
+    def test_binary_tree(self):
+        g = gen_binary_tree(7)
+        assert sorted(g.nexts(0)) == [1, 2]
+        assert sorted(g.nexts(1)) == [3, 4]
+        assert covers_all(g, 0)
+
+    def test_binary_tree_star_cross_host_only_masters(self):
+        g = gen_binary_tree_star(self.two_hosts)
+        masters = {0, 3}
+        for i, j in g.edges():
+            if self.two_hosts[i].ipv4 != self.two_hosts[j].ipv4:
+                assert i in masters and j in masters
+        assert covers_all(g, 0)
+
+    def test_multi_binary_tree_star_one_per_master(self):
+        gs = gen_multi_binary_tree_star(self.two_hosts)
+        assert len(gs) == 2
+        assert covers_all(gs[0], 0)
+        # rotated tree is rooted at the other master
+        assert covers_all(gs[1], 3)
+
+    def test_circular_pair(self):
+        reduce_g, bcast_g = gen_circular_graph_pair(4, 0)
+        assert all(reduce_g.is_self_loop(i) for i in range(4))
+        # reduce chain 1->2->3->0, bcast chain 0->1->2->3 (rotated by r)
+        assert reduce_g.edges() == [(1, 2), (2, 3), (3, 0)]
+        assert bcast_g.edges() == [(0, 1), (1, 2), (2, 3)]
+
+    def test_default_reduce_graph(self):
+        b = gen_star_bcast_graph(4, 0)
+        r = gen_default_reduce_graph(b)
+        assert all(r.is_self_loop(i) for i in range(4))
+        assert sorted(r.prevs(0)) == [1, 2, 3]
+
+    def test_reverse_involution(self):
+        g = gen_binary_tree(6)
+        assert g.reverse().reverse() == g
+
+
+class TestInterval:
+    def test_even_partition(self):
+        parts = even_partition(0, 10, 3)
+        assert parts == [(0, 4), (4, 7), (7, 10)]
+        assert even_partition(0, 2, 4) == [(0, 1), (1, 2), (2, 2), (2, 2)]
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            even_partition(0, 10, 0)
+
+
+class TestReviewRegressions:
+    def test_gen_peer_list_np_zero(self):
+        assert HostList.parse("10.0.0.1:2").gen_peer_list(0) == PeerList()
+
+    def test_peer_id_port_range_checked(self):
+        with pytest.raises(ValueError):
+            PeerID.parse("1.2.3.4:-1")
+        with pytest.raises(ValueError):
+            PeerID.from_host("1.2.3.4", 70000)
+
+    def test_ipv4_rejects_sloppy_int_forms(self):
+        for s in [" 10.0.0.1", "1_0.0.0.1", "+1.0.0.1"]:
+            with pytest.raises(ValueError):
+                parse_ipv4(s)
