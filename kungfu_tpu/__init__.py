@@ -1,0 +1,75 @@
+"""kungfu-tpu: adaptive, elastic, decentralized distributed training on TPU.
+
+A TPU-native rebuild of the reference KungFu framework's capabilities:
+
+- **Data plane**: XLA ICI collectives on a `jax.sharding.Mesh`
+  (`kungfu_tpu.ops`, `kungfu_tpu.parallel`) — the role NCCL + TCP all-reduce
+  graphs play in the reference.
+- **Control plane**: `libkf`, a C++ DCN runtime (framed named messages over
+  TCP, blob store, digest consensus, epoch-fenced membership) —
+  `kungfu_tpu.peer` / `kungfu_tpu.ffi`.
+- **Distributed optimizers**: SyncSGD, synchronous model averaging (SMA),
+  async pair averaging, adaptive hybrids (`kungfu_tpu.optimizers`).
+- **Elastic runtime**: config server, `kfrun` launcher, online cluster
+  resize (`kungfu_tpu.run`, `kungfu_tpu.elastic`).
+
+Top-level helpers mirror the reference's `kungfu.*` API
+(reference: srcs/python/kungfu/__init__.py): `current_rank()`,
+`current_cluster_size()`, `current_local_rank()`, `current_local_size()`,
+`barrier()`, plus `init()`/`shutdown()` for explicit lifecycle.
+"""
+
+from __future__ import annotations
+
+import atexit
+from typing import Optional
+
+from .peer import Peer
+
+__version__ = "0.1.0"
+
+_default_peer: Optional[Peer] = None
+
+
+def init() -> Peer:
+    """Initialize (or return) the process-global peer from the KF_* env."""
+    global _default_peer
+    if _default_peer is None:
+        _default_peer = Peer().start()
+        atexit.register(shutdown)
+    return _default_peer
+
+
+def shutdown():
+    global _default_peer
+    if _default_peer is not None:
+        peer, _default_peer = _default_peer, None
+        peer.close()
+
+
+def peer() -> Peer:
+    return init()
+
+
+def current_rank() -> int:
+    return init().rank
+
+
+def current_cluster_size() -> int:
+    return init().size
+
+
+def current_local_rank() -> int:
+    return init().local_rank
+
+
+def current_local_size() -> int:
+    return init().local_size
+
+
+def barrier():
+    init().barrier()
+
+
+def run_barrier():  # reference-compat alias
+    barrier()

@@ -1,0 +1,124 @@
+"""The KF_* environment protocol between launcher and workers.
+
+The launcher (kfrun) configures each worker process purely through
+environment variables — this *is* the bootstrap mechanism, exactly as in the
+reference (reference: srcs/go/kungfu/env/envs.go:4-14, config.go:24-76).
+A process started without these vars becomes a single-process cluster of
+itself, so every program using kungfu_tpu also runs standalone.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .plan import HostList, PeerID, PeerList
+
+SELF_SPEC = "KF_SELF_SPEC"
+INIT_PEERS = "KF_INIT_PEERS"
+HOST_LIST = "KF_HOST_LIST"
+PARENT_ID = "KF_PARENT_ID"
+INIT_CLUSTER_VERSION = "KF_INIT_CLUSTER_VERSION"
+ALLREDUCE_STRATEGY = "KF_ALLREDUCE_STRATEGY"
+CONFIG_SERVER = "KF_CONFIG_SERVER"
+# user-tunable runtime config (forwarded by the launcher if set)
+CONFIG_VARS = (
+    "KF_LOG_LEVEL",
+    "KF_STALL_DETECTION",
+    "KF_TIMEOUT_MS",
+    "KF_ENABLE_MONITORING",
+)
+
+ALL_BOOTSTRAP_VARS = (
+    SELF_SPEC,
+    INIT_PEERS,
+    HOST_LIST,
+    PARENT_ID,
+    INIT_CLUSTER_VERSION,
+    ALLREDUCE_STRATEGY,
+    CONFIG_SERVER,
+)
+
+
+@dataclass
+class Config:
+    """Parsed bootstrap configuration of one worker process."""
+
+    self_id: PeerID
+    init_peers: PeerList
+    version: int = 0
+    strategy: str = "AUTO"
+    parent: Optional[PeerID] = None
+    host_list: HostList = field(default_factory=HostList)
+    config_server: str = ""
+    timeout_ms: int = 0
+    single_process: bool = False
+
+    @property
+    def rank(self) -> int:
+        r = self.init_peers.rank(self.self_id)
+        if r is None:
+            raise ValueError(
+                f"self {self.self_id} not in peer list {self.init_peers}"
+            )
+        return r
+
+
+def from_env(environ: Optional[Dict[str, str]] = None) -> Config:
+    """Parse worker config from the environment.
+
+    Without KF_SELF_SPEC the process is a standalone single-worker cluster
+    (the reference's single-process fallback, env/config.go:24-76).
+    """
+    e = os.environ if environ is None else environ
+    self_spec = e.get(SELF_SPEC, "")
+    if not self_spec:
+        solo = PeerID.from_host("127.0.0.1", 0)
+        return Config(
+            self_id=solo,
+            init_peers=PeerList([solo]),
+            single_process=True,
+            timeout_ms=int(e.get("KF_TIMEOUT_MS", "0")),
+        )
+    self_id = PeerID.parse(self_spec)
+    peers = PeerList.parse(e.get(INIT_PEERS, self_spec))
+    parent = e.get(PARENT_ID, "")
+    return Config(
+        self_id=self_id,
+        init_peers=peers,
+        version=int(e.get(INIT_CLUSTER_VERSION, "0")),
+        strategy=e.get(ALLREDUCE_STRATEGY, "AUTO"),
+        parent=PeerID.parse(parent) if parent else None,
+        host_list=HostList.parse(e.get(HOST_LIST, "")),
+        config_server=e.get(CONFIG_SERVER, ""),
+        timeout_ms=int(e.get("KF_TIMEOUT_MS", "0")),
+    )
+
+
+def worker_env(
+    self_id: PeerID,
+    peers: PeerList,
+    version: int,
+    strategy: str = "AUTO",
+    parent: Optional[PeerID] = None,
+    host_list: Optional[HostList] = None,
+    config_server: str = "",
+) -> Dict[str, str]:
+    """Build the env-var dict the launcher injects into a worker."""
+    env = {
+        SELF_SPEC: str(self_id),
+        INIT_PEERS: str(peers),
+        INIT_CLUSTER_VERSION: str(version),
+        ALLREDUCE_STRATEGY: strategy,
+    }
+    if parent is not None:
+        env[PARENT_ID] = str(parent)
+    if host_list:
+        env[HOST_LIST] = str(host_list)
+    if config_server:
+        env[CONFIG_SERVER] = config_server
+    for var in CONFIG_VARS:
+        if var in os.environ:
+            env[var] = os.environ[var]
+    return env

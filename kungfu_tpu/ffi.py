@@ -1,0 +1,382 @@
+"""ctypes bindings for libkf, the C++ DCN control plane.
+
+Loads ``libkf.so`` from ``kungfu_tpu/native/`` (built by
+``make -C kungfu_tpu/native``) and exposes a thin, typed wrapper. All
+blocking calls release the GIL (ctypes does this for foreign calls), so
+collectives can overlap with Python compute threads — the async-callback
+role the reference's cgo bridge plays (reference:
+srcs/go/libkufu-comm/main.go callOP) is covered here by calling into libkf
+from Python threads/executors instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.environ.get("KF_LIB", os.path.join(_LIB_DIR, "libkf.so"))
+
+# error codes (mirror include/kf.h)
+KF_OK = 0
+KF_ERR = -1
+KF_ERR_TIMEOUT = -2
+KF_ERR_EPOCH = -3
+KF_ERR_CONN = -4
+KF_ERR_NOTFOUND = -5
+KF_ERR_ARG = -6
+
+_ERR_NAMES = {
+    KF_ERR: "generic failure",
+    KF_ERR_TIMEOUT: "timeout",
+    KF_ERR_EPOCH: "stale epoch token",
+    KF_ERR_CONN: "connection failure",
+    KF_ERR_NOTFOUND: "not found",
+    KF_ERR_ARG: "invalid argument",
+}
+
+# strategy codes
+STRATEGIES = {
+    "STAR": 0,
+    "RING": 1,
+    "CLIQUE": 2,
+    "TREE": 3,
+    "BINARY_TREE": 4,
+    "BINARY_TREE_STAR": 5,
+    "MULTI_BINARY_TREE_STAR": 6,
+    "AUTO": 7,
+}
+
+_NP_DTYPE_CODES = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.uint16): 2,
+    np.dtype(np.int16): 3,
+    np.dtype(np.uint32): 4,
+    np.dtype(np.int32): 5,
+    np.dtype(np.uint64): 6,
+    np.dtype(np.int64): 7,
+    np.dtype(np.float16): 8,
+    # bf16 (code 9) has no numpy dtype; pass uint16 views with dtype_code=9
+    np.dtype(np.float32): 10,
+    np.dtype(np.float64): 11,
+}
+
+_OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
+
+CONTROL_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64
+)
+
+
+class KfError(RuntimeError):
+    def __init__(self, code: int, what: str):
+        super().__init__(f"{what}: {_ERR_NAMES.get(code, code)} ({code})")
+        self.code = code
+
+
+def _check(code: int, what: str) -> int:
+    if code < 0:
+        raise KfError(code, what)
+    return code
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_LIB_PATH)
+    P = ctypes.c_void_p
+    i64 = ctypes.c_int64
+    u32 = ctypes.c_uint32
+    cs = ctypes.c_char_p
+    sigs = {
+        "kf_peer_new": ([cs, cs, u32, ctypes.c_int, i64], P),
+        "kf_peer_start": ([P], ctypes.c_int),
+        "kf_peer_stop": ([P], ctypes.c_int),
+        "kf_peer_free": ([P], None),
+        "kf_peer_update": ([P, cs, u32], ctypes.c_int),
+        "kf_rank": ([P], ctypes.c_int),
+        "kf_size": ([P], ctypes.c_int),
+        "kf_local_rank": ([P], ctypes.c_int),
+        "kf_local_size": ([P], ctypes.c_int),
+        "kf_version": ([P], u32),
+        "kf_uid": ([P], ctypes.c_uint64),
+        "kf_barrier": ([P], ctypes.c_int),
+        "kf_all_reduce": ([P, P, P, i64, ctypes.c_int, ctypes.c_int, cs],
+                          ctypes.c_int),
+        "kf_reduce": ([P, P, P, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                       cs], ctypes.c_int),
+        "kf_broadcast": ([P, P, P, i64, ctypes.c_int, ctypes.c_int, cs],
+                         ctypes.c_int),
+        "kf_gather": ([P, P, i64, P, i64, ctypes.c_int, ctypes.c_int, cs],
+                      ctypes.c_int),
+        "kf_all_gather": ([P, P, i64, P, ctypes.c_int, cs], ctypes.c_int),
+        "kf_consensus": ([P, P, i64, cs], ctypes.c_int),
+        "kf_save": ([P, cs, P, i64], ctypes.c_int),
+        "kf_save_version": ([P, cs, cs, P, i64], ctypes.c_int),
+        "kf_request": ([P, ctypes.c_int, cs, P, i64], ctypes.c_int),
+        "kf_request_version": ([P, ctypes.c_int, cs, cs, P, i64],
+                               ctypes.c_int),
+        "kf_set_control_handler": ([P, CONTROL_CB, P], ctypes.c_int),
+        "kf_send_control": ([P, cs, cs, P, i64], ctypes.c_int),
+        "kf_ping": ([P, ctypes.c_int, ctypes.POINTER(i64)], ctypes.c_int),
+        "kf_stats": ([P, ctypes.POINTER(ctypes.c_uint64),
+                      ctypes.POINTER(ctypes.c_uint64)], None),
+        "kf_version_string": ([], cs),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    _lib = lib
+    return lib
+
+
+def dtype_code(dt: np.dtype) -> int:
+    try:
+        return _NP_DTYPE_CODES[np.dtype(dt)]
+    except KeyError:
+        raise ValueError(f"unsupported dtype for control plane: {dt}")
+
+
+def op_code(op: str) -> int:
+    try:
+        return _OPS[op]
+    except KeyError:
+        raise ValueError(f"unsupported reduce op: {op}")
+
+
+def _buf_ptr(a: np.ndarray) -> ctypes.c_void_p:
+    return ctypes.c_void_p(a.ctypes.data)
+
+
+class NativePeer:
+    """Thin RAII handle over kf_peer. One per process, normally."""
+
+    def __init__(
+        self,
+        self_spec: str,
+        peers: str,
+        version: int = 0,
+        strategy: str = "AUTO",
+        timeout_ms: int = 0,
+    ):
+        self._lib = load()
+        self._h = self._lib.kf_peer_new(
+            self_spec.encode(),
+            peers.encode(),
+            version,
+            STRATEGIES[strategy.upper()],
+            timeout_ms,
+        )
+        if not self._h:
+            raise ValueError(
+                f"kf_peer_new failed (self={self_spec!r} peers={peers!r})"
+            )
+        self._control_cb = None  # keep callback object alive
+
+    def start(self):
+        _check(self._lib.kf_peer_start(self._h), "peer start")
+
+    def stop(self):
+        if self._h:
+            self._lib.kf_peer_stop(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.kf_peer_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def update(self, peers: str, version: int):
+        _check(self._lib.kf_peer_update(self._h, peers.encode(), version),
+               "peer update")
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def rank(self) -> int:
+        return self._lib.kf_rank(self._h)
+
+    @property
+    def size(self) -> int:
+        return self._lib.kf_size(self._h)
+
+    @property
+    def local_rank(self) -> int:
+        return self._lib.kf_local_rank(self._h)
+
+    @property
+    def local_size(self) -> int:
+        return self._lib.kf_local_size(self._h)
+
+    @property
+    def version(self) -> int:
+        return self._lib.kf_version(self._h)
+
+    @property
+    def uid(self) -> int:
+        return self._lib.kf_uid(self._h)
+
+    # -- collectives --------------------------------------------------------
+
+    def barrier(self):
+        _check(self._lib.kf_barrier(self._h), "barrier")
+
+    def all_reduce(self, x: np.ndarray, op: str = "sum",
+                   name: str = "") -> np.ndarray:
+        x = np.ascontiguousarray(x)
+        out = np.empty_like(x)
+        _check(
+            self._lib.kf_all_reduce(self._h, _buf_ptr(x), _buf_ptr(out),
+                                    x.size, dtype_code(x.dtype), op_code(op),
+                                    name.encode() or b"allreduce"),
+            f"all_reduce {name}",
+        )
+        return out
+
+    def reduce(self, x: np.ndarray, op: str = "sum", root: int = 0,
+               name: str = "") -> Optional[np.ndarray]:
+        """Reduce to `root`; returns the result there, None elsewhere."""
+        x = np.ascontiguousarray(x)
+        out = np.empty_like(x)
+        _check(
+            self._lib.kf_reduce(self._h, _buf_ptr(x), _buf_ptr(out), x.size,
+                                dtype_code(x.dtype), op_code(op), root,
+                                name.encode() or b"reduce"),
+            f"reduce {name}",
+        )
+        return out if self.rank == root else None
+
+    def broadcast(self, x: np.ndarray, root: int = 0,
+                  name: str = "") -> np.ndarray:
+        x = np.ascontiguousarray(x)
+        out = x.copy() if self.rank == root else np.empty_like(x)
+        _check(
+            self._lib.kf_broadcast(self._h, _buf_ptr(x), _buf_ptr(out),
+                                   x.size, dtype_code(x.dtype), root,
+                                   name.encode() or b"broadcast"),
+            f"broadcast {name}",
+        )
+        return out
+
+    def gather(self, x: np.ndarray, root: int = 0,
+               name: str = "") -> Optional[np.ndarray]:
+        x = np.ascontiguousarray(x)
+        np_total = x.size * self.size
+        out = np.empty((self.size,) + x.shape, dtype=x.dtype)
+        _check(
+            self._lib.kf_gather(self._h, _buf_ptr(x), x.size, _buf_ptr(out),
+                                np_total, dtype_code(x.dtype), root,
+                                name.encode() or b"gather"),
+            f"gather {name}",
+        )
+        return out if self.rank == root else None
+
+    def all_gather(self, x: np.ndarray, name: str = "") -> np.ndarray:
+        x = np.ascontiguousarray(x)
+        out = np.empty((self.size,) + x.shape, dtype=x.dtype)
+        _check(
+            self._lib.kf_all_gather(self._h, _buf_ptr(x), x.size,
+                                    _buf_ptr(out), dtype_code(x.dtype),
+                                    name.encode() or b"allgather"),
+            f"all_gather {name}",
+        )
+        return out
+
+    def consensus(self, data: bytes, name: str = "consensus") -> bool:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        rc = _check(
+            self._lib.kf_consensus(self._h, _buf_ptr(buf), buf.size,
+                                   name.encode()),
+            f"consensus {name}",
+        )
+        return rc == 1
+
+    # -- store + p2p --------------------------------------------------------
+
+    def save(self, name: str, x: np.ndarray, version: Optional[str] = None):
+        x = np.ascontiguousarray(x)
+        nbytes = x.size * x.itemsize
+        if version is None:
+            _check(self._lib.kf_save(self._h, name.encode(), _buf_ptr(x),
+                                     nbytes), f"save {name}")
+        else:
+            _check(
+                self._lib.kf_save_version(self._h, version.encode(),
+                                          name.encode(), _buf_ptr(x), nbytes),
+                f"save {name}@{version}",
+            )
+
+    def request(self, rank: int, name: str, like: np.ndarray,
+                version: Optional[str] = None) -> np.ndarray:
+        out = np.empty_like(np.ascontiguousarray(like))
+        nbytes = out.size * out.itemsize
+        if version is None:
+            _check(
+                self._lib.kf_request(self._h, rank, name.encode(),
+                                     _buf_ptr(out), nbytes),
+                f"request {name} from {rank}",
+            )
+        else:
+            _check(
+                self._lib.kf_request_version(self._h, rank, version.encode(),
+                                             name.encode(), _buf_ptr(out),
+                                             nbytes),
+                f"request {name}@{version} from {rank}",
+            )
+        return out
+
+    # -- control + monitoring ----------------------------------------------
+
+    def set_control_handler(self, fn):
+        """fn(name: str, payload: bytes) invoked on a server thread."""
+        if fn is None:
+            self._control_cb = None
+            _check(self._lib.kf_set_control_handler(
+                self._h, CONTROL_CB(0), None), "clear control handler")
+            return
+
+        def trampoline(_user, name, data, n):
+            payload = ctypes.string_at(data, n) if n else b""
+            try:
+                fn(name.decode(), payload)
+            except Exception as e:  # never let exceptions cross into C
+                print(f"[kf] control handler error: {e}", flush=True)
+
+        self._control_cb = CONTROL_CB(trampoline)
+        _check(self._lib.kf_set_control_handler(self._h, self._control_cb,
+                                                None), "set control handler")
+
+    def send_control(self, dest: str, name: str, payload: bytes = b""):
+        buf = np.frombuffer(payload, dtype=np.uint8) if payload else None
+        ptr = _buf_ptr(buf) if buf is not None else None
+        _check(
+            self._lib.kf_send_control(self._h, dest.encode(), name.encode(),
+                                      ptr, len(payload)),
+            f"send_control {name} to {dest}",
+        )
+
+    def ping(self, rank: int) -> int:
+        rtt = ctypes.c_int64(0)
+        _check(self._lib.kf_ping(self._h, rank, ctypes.byref(rtt)),
+               f"ping {rank}")
+        return rtt.value
+
+    def stats(self):
+        eg = ctypes.c_uint64(0)
+        ing = ctypes.c_uint64(0)
+        self._lib.kf_stats(self._h, ctypes.byref(eg), ctypes.byref(ing))
+        return {"egress_bytes": eg.value, "ingress_bytes": ing.value}

@@ -1,0 +1,140 @@
+/* libkf — TPU-native DCN control plane for kungfu-tpu.
+ *
+ * This is the C API consumed by Python via ctypes. It provides the
+ * runtime the reference implements in Go (reference: srcs/go/rchannel,
+ * srcs/go/kungfu/{peer,session}, srcs/go/store): framed named messages over
+ * TCP, an epoch-token-fenced peer lifecycle, graph-based CPU collectives,
+ * digest consensus, a named blob store with a versioned window, and P2P
+ * blob request/response. The TPU *data plane* (gradient all-reduce) lives
+ * in XLA/ICI and never touches this library; this is the control plane for
+ * elasticity, consensus, model exchange across DCN, and non-TPU testing.
+ *
+ * Thread-safety: all functions on a kf_peer are safe to call from multiple
+ * threads; collectives on distinct names may run concurrently.
+ * All blocking calls honor the timeout configured at peer creation.
+ */
+#ifndef KF_H
+#define KF_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct kf_peer kf_peer;
+
+/* dtype codes (wire + kernel) */
+enum {
+    KF_U8 = 0,
+    KF_I8 = 1,
+    KF_U16 = 2,
+    KF_I16 = 3,
+    KF_U32 = 4,
+    KF_I32 = 5,
+    KF_U64 = 6,
+    KF_I64 = 7,
+    KF_F16 = 8,
+    KF_BF16 = 9,
+    KF_F32 = 10,
+    KF_F64 = 11,
+};
+
+/* reduce op codes */
+enum { KF_SUM = 0, KF_MIN = 1, KF_MAX = 2, KF_PROD = 3 };
+
+/* all-reduce topology strategies */
+enum {
+    KF_STRATEGY_STAR = 0,
+    KF_STRATEGY_RING = 1,
+    KF_STRATEGY_CLIQUE = 2,
+    KF_STRATEGY_TREE = 3,
+    KF_STRATEGY_BINARY_TREE = 4,
+    KF_STRATEGY_BINARY_TREE_STAR = 5,
+    KF_STRATEGY_MULTI_BINARY_TREE_STAR = 6,
+    KF_STRATEGY_AUTO = 7,
+};
+
+/* error codes (negative returns) */
+enum {
+    KF_OK = 0,
+    KF_ERR = -1,          /* generic failure */
+    KF_ERR_TIMEOUT = -2,  /* blocking op timed out */
+    KF_ERR_EPOCH = -3,    /* stale epoch token rejected */
+    KF_ERR_CONN = -4,     /* cannot establish connection */
+    KF_ERR_NOTFOUND = -5, /* P2P request: blob absent on responder */
+    KF_ERR_ARG = -6,      /* invalid argument */
+};
+
+/* --- lifecycle ---------------------------------------------------------- */
+
+/* self_spec: "ip:port"; peers: comma-separated "ip:port" rank list (must
+ * contain self); version: initial cluster epoch; strategy: KF_STRATEGY_*.
+ * timeout_ms: per-blocking-op timeout (0 = no timeout). */
+kf_peer *kf_peer_new(const char *self_spec, const char *peers,
+                     uint32_t version, int strategy, int64_t timeout_ms);
+int kf_peer_start(kf_peer *);                 /* start server threads */
+int kf_peer_stop(kf_peer *);                  /* stop + join */
+void kf_peer_free(kf_peer *);
+
+/* Switch to a new membership epoch: bump token, drop connections to peers
+ * not in the new list, rebuild the session. Does NOT barrier — callers
+ * barrier explicitly once all peers updated. */
+int kf_peer_update(kf_peer *, const char *peers, uint32_t version);
+
+int kf_rank(kf_peer *);
+int kf_size(kf_peer *);
+int kf_local_rank(kf_peer *);
+int kf_local_size(kf_peer *);
+uint32_t kf_version(kf_peer *);
+uint64_t kf_uid(kf_peer *);
+
+/* --- collectives (control plane, CPU buffers) --------------------------- */
+
+int kf_barrier(kf_peer *);
+int kf_all_reduce(kf_peer *, const void *send, void *recv, int64_t count,
+                  int dtype, int op, const char *name);
+int kf_reduce(kf_peer *, const void *send, void *recv, int64_t count,
+              int dtype, int op, int root, const char *name);
+int kf_broadcast(kf_peer *, const void *send, void *recv, int64_t count,
+                 int dtype, int root, const char *name);
+int kf_gather(kf_peer *, const void *send, int64_t count, void *recv,
+              int64_t total_count, int dtype, int root, const char *name);
+int kf_all_gather(kf_peer *, const void *send, int64_t count, void *recv,
+                  int dtype, const char *name);
+/* returns 1 if all peers passed identical bytes, 0 if divergent, <0 error */
+int kf_consensus(kf_peer *, const void *data, int64_t n, const char *name);
+
+/* --- named blob store + P2P -------------------------------------------- */
+
+int kf_save(kf_peer *, const char *name, const void *data, int64_t n);
+int kf_save_version(kf_peer *, const char *version, const char *name,
+                    const void *data, int64_t n);
+/* Fetch blob `name` from peer at `rank`; out must hold n bytes. */
+int kf_request(kf_peer *, int rank, const char *name, void *out, int64_t n);
+int kf_request_version(kf_peer *, int rank, const char *version,
+                       const char *name, void *out, int64_t n);
+
+/* --- control channel ---------------------------------------------------- */
+
+/* Handler invoked (on a server thread) for every Control message received. */
+typedef void (*kf_control_cb)(void *user, const char *name, const void *data,
+                              int64_t n);
+int kf_set_control_handler(kf_peer *, kf_control_cb cb, void *user);
+/* Send a control message to an arbitrary address (e.g. a runner). */
+int kf_send_control(kf_peer *, const char *dest_spec, const char *name,
+                    const void *data, int64_t n);
+
+/* --- monitoring --------------------------------------------------------- */
+
+int kf_ping(kf_peer *, int rank, int64_t *rtt_us); /* RTT to peer */
+void kf_stats(kf_peer *, uint64_t *egress_bytes, uint64_t *ingress_bytes);
+
+/* library version string */
+const char *kf_version_string(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* KF_H */

@@ -1,0 +1,367 @@
+#include "core.hpp"
+
+#include <cstdarg>
+#include <cstdlib>
+#include <ctime>
+#include <mutex>
+
+namespace kf {
+
+// ---------------------------------------------------------------- logging
+
+LogLevel log_level() {
+    static LogLevel lvl = [] {
+        const char *e = std::getenv("KF_LOG_LEVEL");
+        if (!e) return LogLevel::warn;
+        std::string s(e);
+        if (s == "debug") return LogLevel::debug;
+        if (s == "info") return LogLevel::info;
+        if (s == "error") return LogLevel::error;
+        return LogLevel::warn;
+    }();
+    return lvl;
+}
+
+void log_at(LogLevel lvl, const char *fmt, ...) {
+    if (lvl < log_level()) return;
+    static std::mutex mu;
+    std::lock_guard<std::mutex> lk(mu);
+    static const char *names[] = {"DEBUG", "INFO", "WARN", "ERROR"};
+    std::fprintf(stderr, "[kf:%s] ", names[int(lvl)]);
+    va_list ap;
+    va_start(ap, fmt);
+    std::vfprintf(stderr, fmt, ap);
+    va_end(ap);
+    std::fputc('\n', stderr);
+}
+
+// ----------------------------------------------------------------- dtypes
+
+size_t dtype_size(Dtype dt) {
+    switch (dt) {
+        case Dtype::u8:
+        case Dtype::i8:
+            return 1;
+        case Dtype::u16:
+        case Dtype::i16:
+        case Dtype::f16:
+        case Dtype::bf16:
+            return 2;
+        case Dtype::u32:
+        case Dtype::i32:
+        case Dtype::f32:
+            return 4;
+        default:
+            return 8;
+    }
+}
+
+namespace {
+
+float f16_to_f32(uint16_t h) {
+    uint32_t sign = uint32_t(h & 0x8000) << 16;
+    uint32_t exp = (h >> 10) & 0x1F;
+    uint32_t man = h & 0x3FF;
+    uint32_t bits;
+    if (exp == 0) {
+        if (man == 0) {
+            bits = sign;
+        } else {  // subnormal: normalize
+            int shift = 0;
+            while (!(man & 0x400)) {
+                man <<= 1;
+                shift++;
+            }
+            man &= 0x3FF;
+            // subnormal value is man * 2^-24; after normalizing by `shift`
+            // the effective exponent is -15 - shift + 1 = -(14 + shift)
+            bits = sign | ((127 - 14 - shift) << 23) | (man << 13);
+        }
+    } else if (exp == 0x1F) {
+        bits = sign | 0x7F800000 | (man << 13);
+    } else {
+        bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+    }
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
+uint16_t f32_to_f16(float f) {
+    uint32_t bits;
+    std::memcpy(&bits, &f, 4);
+    uint16_t sign = uint16_t((bits >> 16) & 0x8000);
+    int32_t exp = int32_t((bits >> 23) & 0xFF) - 127 + 15;
+    uint32_t man = bits & 0x7FFFFF;
+    if (exp >= 0x1F) return sign | 0x7C00;  // inf/overflow
+    if (exp <= 0) {
+        if (exp < -10) return sign;  // underflow to zero
+        man |= 0x800000;
+        uint32_t shift = uint32_t(14 - exp);
+        return sign | uint16_t(man >> shift);
+    }
+    return sign | uint16_t(exp << 10) | uint16_t(man >> 13);
+}
+
+float bf16_to_f32(uint16_t h) {
+    uint32_t bits = uint32_t(h) << 16;
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
+uint16_t f32_to_bf16(float f) {
+    uint32_t bits;
+    std::memcpy(&bits, &f, 4);
+    // round-to-nearest-even on the dropped 16 bits
+    uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+    return uint16_t((bits + rounding) >> 16);
+}
+
+template <typename T>
+void accumulate_typed(T *dst, const T *src, int64_t n, ROp op) {
+    switch (op) {
+        case ROp::sum:
+            for (int64_t i = 0; i < n; i++) dst[i] = T(dst[i] + src[i]);
+            break;
+        case ROp::min:
+            for (int64_t i = 0; i < n; i++)
+                dst[i] = src[i] < dst[i] ? src[i] : dst[i];
+            break;
+        case ROp::max:
+            for (int64_t i = 0; i < n; i++)
+                dst[i] = src[i] > dst[i] ? src[i] : dst[i];
+            break;
+        case ROp::prod:
+            for (int64_t i = 0; i < n; i++) dst[i] = T(dst[i] * src[i]);
+            break;
+    }
+}
+
+template <float (*FromBits)(uint16_t), uint16_t (*ToBits)(float)>
+void accumulate_16bit_float(uint16_t *dst, const uint16_t *src, int64_t n,
+                            ROp op) {
+    for (int64_t i = 0; i < n; i++) {
+        float a = FromBits(dst[i]), b = FromBits(src[i]), r;
+        switch (op) {
+            case ROp::sum:
+                r = a + b;
+                break;
+            case ROp::min:
+                r = b < a ? b : a;
+                break;
+            case ROp::max:
+                r = b > a ? b : a;
+                break;
+            default:
+                r = a * b;
+                break;
+        }
+        dst[i] = ToBits(r);
+    }
+}
+
+}  // namespace
+
+void reduce_accumulate(void *dst, const void *src, int64_t count, Dtype dt,
+                       ROp op) {
+    switch (dt) {
+        case Dtype::u8:
+            return accumulate_typed((uint8_t *)dst, (const uint8_t *)src,
+                                    count, op);
+        case Dtype::i8:
+            return accumulate_typed((int8_t *)dst, (const int8_t *)src, count,
+                                    op);
+        case Dtype::u16:
+            return accumulate_typed((uint16_t *)dst, (const uint16_t *)src,
+                                    count, op);
+        case Dtype::i16:
+            return accumulate_typed((int16_t *)dst, (const int16_t *)src,
+                                    count, op);
+        case Dtype::u32:
+            return accumulate_typed((uint32_t *)dst, (const uint32_t *)src,
+                                    count, op);
+        case Dtype::i32:
+            return accumulate_typed((int32_t *)dst, (const int32_t *)src,
+                                    count, op);
+        case Dtype::u64:
+            return accumulate_typed((uint64_t *)dst, (const uint64_t *)src,
+                                    count, op);
+        case Dtype::i64:
+            return accumulate_typed((int64_t *)dst, (const int64_t *)src,
+                                    count, op);
+        case Dtype::f16:
+            return accumulate_16bit_float<f16_to_f32, f32_to_f16>(
+                (uint16_t *)dst, (const uint16_t *)src, count, op);
+        case Dtype::bf16:
+            return accumulate_16bit_float<bf16_to_f32, f32_to_bf16>(
+                (uint16_t *)dst, (const uint16_t *)src, count, op);
+        case Dtype::f32:
+            return accumulate_typed((float *)dst, (const float *)src, count,
+                                    op);
+        case Dtype::f64:
+            return accumulate_typed((double *)dst, (const double *)src, count,
+                                    op);
+    }
+}
+
+// ------------------------------------------------------------------ peers
+
+std::string PeerID::str() const {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "%u.%u.%u.%u:%u", (ipv4 >> 24) & 0xFF,
+                  (ipv4 >> 16) & 0xFF, (ipv4 >> 8) & 0xFF, ipv4 & 0xFF, port);
+    return buf;
+}
+
+bool parse_peer(const std::string &s, PeerID *out) {
+    unsigned a, b, c, d, p;
+    char tail;
+    if (std::sscanf(s.c_str(), "%u.%u.%u.%u:%u%c", &a, &b, &c, &d, &p,
+                    &tail) != 5)
+        return false;
+    if (a > 255 || b > 255 || c > 255 || d > 255 || p > 65535) return false;
+    out->ipv4 = (a << 24) | (b << 16) | (c << 8) | d;
+    out->port = uint16_t(p);
+    return true;
+}
+
+bool parse_peer_list(const std::string &s, std::vector<PeerID> *out) {
+    out->clear();
+    if (s.empty()) return true;
+    size_t pos = 0;
+    while (pos <= s.size()) {
+        size_t comma = s.find(',', pos);
+        std::string part = s.substr(
+            pos, comma == std::string::npos ? std::string::npos : comma - pos);
+        PeerID id;
+        if (!parse_peer(part, &id)) return false;
+        out->push_back(id);
+        if (comma == std::string::npos) break;
+        pos = comma + 1;
+    }
+    return true;
+}
+
+// ----------------------------------------------------------- topologies
+// Shapes mirror kungfu_tpu/plan/topology.py (reference:
+// srcs/go/plan/topology.go); locality rule: only host-master ranks carry
+// cross-host edges.
+
+namespace {
+
+void local_masters(const std::vector<PeerID> &peers, std::vector<int> *masters,
+                   std::unordered_map<uint32_t, int> *host_master) {
+    for (int r = 0; r < int(peers.size()); r++) {
+        if (!host_master->count(peers[r].ipv4)) {
+            (*host_master)[peers[r].ipv4] = r;
+            masters->push_back(r);
+        }
+    }
+}
+
+Graph binary_tree_star(const std::vector<PeerID> &peers, int offset) {
+    Graph g(int(peers.size()));
+    std::vector<int> masters;
+    std::unordered_map<uint32_t, int> host_master;
+    local_masters(peers, &masters, &host_master);
+    for (int r = 0; r < int(peers.size()); r++) {
+        int m = host_master[peers[r].ipv4];
+        if (m != r) g.add_edge(m, r);
+    }
+    int k = int(masters.size());
+    if (k > 1) {
+        for (int i = 0; i < k; i++) {
+            for (int j : {2 * i + 1, 2 * i + 2}) {
+                if (j < k)
+                    g.add_edge(masters[(i + offset) % k],
+                               masters[(j + offset) % k]);
+            }
+        }
+    }
+    return g;
+}
+
+std::pair<Graph, Graph> circular_pair(int k, int r) {
+    Graph reduce(k), bcast(k);
+    for (int i = 0; i < k; i++) reduce.add_edge(i, i);
+    for (int i = 1; i < k; i++) {
+        reduce.add_edge((r + i) % k, (r + i + 1) % k);
+        bcast.add_edge((r + i - 1) % k, (r + i) % k);
+    }
+    return {reduce, bcast};
+}
+
+}  // namespace
+
+Graph star_graph(int k, int r) {
+    Graph g(k);
+    for (int i = 0; i < k; i++)
+        if (i != r) g.add_edge(r, i);
+    return g;
+}
+
+Graph reduce_graph_of(const Graph &bcast) {
+    Graph g = bcast.reverse();
+    for (int i = 0; i < g.n; i++) g.add_edge(i, i);
+    return g;
+}
+
+std::vector<GraphPair> build_strategy(Strategy s,
+                                      const std::vector<PeerID> &peers) {
+    const int k = int(peers.size());
+    std::vector<int> masters;
+    std::unordered_map<uint32_t, int> host_master;
+    local_masters(peers, &masters, &host_master);
+
+    if (s == Strategy::auto_select)
+        s = masters.size() <= 1 ? Strategy::star : Strategy::binary_tree_star;
+
+    std::vector<GraphPair> out;
+    auto from_bcast = [&](const Graph &b) {
+        out.push_back({reduce_graph_of(b), b});
+    };
+    switch (s) {
+        case Strategy::star:
+            from_bcast(star_graph(k, 0));
+            break;
+        case Strategy::ring:
+            for (int r = 0; r < k; r++) out.push_back(circular_pair(k, r));
+            break;
+        case Strategy::clique:
+            for (int r = 0; r < k; r++) from_bcast(star_graph(k, r));
+            break;
+        case Strategy::tree: {
+            Graph g(k);
+            for (int r = 0; r < k; r++) {
+                int m = host_master[peers[r].ipv4];
+                if (m != r) g.add_edge(m, r);
+            }
+            for (size_t i = 1; i < masters.size(); i++)
+                g.add_edge(masters[0], masters[i]);
+            from_bcast(g);
+            break;
+        }
+        case Strategy::binary_tree: {
+            Graph g(k);
+            for (int i = 0; i < k; i++)
+                for (int j : {2 * i + 1, 2 * i + 2})
+                    if (j < k) g.add_edge(i, j);
+            from_bcast(g);
+            break;
+        }
+        case Strategy::binary_tree_star:
+            from_bcast(binary_tree_star(peers, 0));
+            break;
+        case Strategy::multi_binary_tree_star:
+            for (size_t i = 0; i < masters.size(); i++)
+                from_bcast(binary_tree_star(peers, int(i)));
+            break;
+        default:
+            from_bcast(star_graph(k, 0));
+            break;
+    }
+    return out;
+}
+
+}  // namespace kf

@@ -1,0 +1,238 @@
+#include "session.hpp"
+
+#include <algorithm>
+#include <cstring>
+#include <thread>
+
+#include "../include/kf.h"
+
+namespace kf {
+
+namespace {
+
+constexpr int64_t kChunkBytes = 1 << 20;  // 1 MiB, like the reference
+constexpr int kMaxChunkThreads = 16;
+
+// Process-independent hash (std::hash is not stable across processes);
+// every rank must pick the same strategy for the same chunk name.
+uint64_t fnv1a(const std::string &s) {
+    uint64_t h = 1469598103934665603ull;
+    for (unsigned char c : s) {
+        h ^= c;
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+}  // namespace
+
+Session::Session(PeerID self, std::vector<PeerID> peers, Strategy strategy,
+                 Client *client, Rendezvous *rdv, int64_t timeout_ms)
+    : self_(self),
+      peers_(std::move(peers)),
+      client_(client),
+      rdv_(rdv),
+      timeout_ms_(timeout_ms) {
+    local_rank_ = 0;
+    local_size_ = 0;
+    for (int i = 0; i < int(peers_.size()); i++) {
+        if (peers_[i] == self_) rank_ = i;
+        if (peers_[i].colocated_with(self_)) {
+            if (rank_ < 0) local_rank_++;
+            local_size_++;
+        }
+    }
+    strategies_ = build_strategy(strategy, peers_);
+}
+
+int Session::send_chunk(int dst_rank, const std::string &name,
+                        const uint8_t *data, int64_t nbytes) {
+    return client_->send(peers_[dst_rank], ConnType::collective, name, 0,
+                         data, size_t(nbytes));
+}
+
+int Session::run_graphs(uint8_t *chunk, int64_t nbytes, Dtype dt, ROp op,
+                        const Graph &rg, const Graph &bg,
+                        const std::string &name) {
+    const int64_t count = nbytes / int64_t(dtype_size(dt));
+    std::vector<uint8_t> incoming;
+    // reduce phase: accumulate children, then forward partial to parent
+    for (int prev : rg.prev[rank_]) {
+        int rc = rdv_->pop(peers_[prev], name, &incoming, timeout_ms_);
+        if (rc != KF_OK) return rc;
+        if (int64_t(incoming.size()) != nbytes) return KF_ERR;
+        reduce_accumulate(chunk, incoming.data(), count, dt, op);
+    }
+    for (int next : rg.next[rank_]) {
+        int rc = send_chunk(next, name, chunk, nbytes);
+        if (rc != KF_OK) return rc;
+    }
+    // broadcast phase: adopt the finished value, then fan out
+    for (int prev : bg.prev[rank_]) {
+        int rc = rdv_->pop(peers_[prev], name, &incoming, timeout_ms_);
+        if (rc != KF_OK) return rc;
+        if (int64_t(incoming.size()) != nbytes) return KF_ERR;
+        std::memcpy(chunk, incoming.data(), size_t(nbytes));
+    }
+    for (int next : bg.next[rank_]) {
+        int rc = send_chunk(next, name, chunk, nbytes);
+        if (rc != KF_OK) return rc;
+    }
+    return KF_OK;
+}
+
+int Session::all_reduce(const void *send, void *recv, int64_t count, Dtype dt,
+                        ROp op, const std::string &name) {
+    const size_t esz = dtype_size(dt);
+    const int64_t nbytes = count * int64_t(esz);
+    if (recv != send) std::memcpy(recv, send, size_t(nbytes));
+    if (peers_.size() <= 1) return KF_OK;
+
+    // split into ~1MiB chunks aligned to element size; each chunk picks a
+    // strategy pair by stable name hash so multi-graph strategies (ring,
+    // clique, multi-tree) spread chunks across roots
+    const int64_t elems_per_chunk =
+        std::max<int64_t>(1, kChunkBytes / int64_t(esz));
+    const int64_t n_chunks = (count + elems_per_chunk - 1) / elems_per_chunk;
+    auto run_chunk = [&](int64_t ci) -> int {
+        const int64_t lo = ci * elems_per_chunk;
+        const int64_t n = std::min(elems_per_chunk, count - lo);
+        const std::string chunk_name =
+            n_chunks == 1 ? name
+                          : name + "[" + std::to_string(lo) + "]";
+        const auto &pair =
+            strategies_[fnv1a(chunk_name) % strategies_.size()];
+        return run_graphs((uint8_t *)recv + lo * int64_t(esz),
+                          n * int64_t(esz), dt, op, pair.first, pair.second,
+                          chunk_name);
+    };
+    if (n_chunks == 1) return run_chunk(0);
+
+    std::vector<int> rcs(size_t(n_chunks), KF_OK);
+    for (int64_t base = 0; base < n_chunks; base += kMaxChunkThreads) {
+        const int64_t hi = std::min<int64_t>(base + kMaxChunkThreads, n_chunks);
+        std::vector<std::thread> ts;
+        for (int64_t ci = base; ci < hi; ci++)
+            ts.emplace_back([&, ci] { rcs[size_t(ci)] = run_chunk(ci); });
+        for (auto &t : ts) t.join();
+    }
+    for (int rc : rcs)
+        if (rc != KF_OK) return rc;
+    return KF_OK;
+}
+
+int Session::reduce(const void *send, void *recv, int64_t count, Dtype dt,
+                    ROp op, int root, const std::string &name) {
+    const int64_t nbytes = count * int64_t(dtype_size(dt));
+    if (recv != send && rank_ == root)
+        std::memcpy(recv, send, size_t(nbytes));
+    if (peers_.size() <= 1) return KF_OK;
+    // star reduce into root; non-roots only need a scratch copy to send
+    std::vector<uint8_t> scratch;
+    uint8_t *buf;
+    if (rank_ == root) {
+        buf = (uint8_t *)recv;
+    } else {
+        scratch.assign((const uint8_t *)send, (const uint8_t *)send + nbytes);
+        buf = scratch.data();
+    }
+    Graph bcast = star_graph(size(), root);
+    Graph rg = reduce_graph_of(bcast);
+    Graph no_bcast(size());
+    return run_graphs(buf, nbytes, dt, op, rg, no_bcast, name);
+}
+
+int Session::broadcast(const void *send, void *recv, int64_t count, Dtype dt,
+                       int root, const std::string &name) {
+    const int64_t nbytes = count * int64_t(dtype_size(dt));
+    if (recv != send && rank_ == root)
+        std::memcpy(recv, send, size_t(nbytes));
+    if (peers_.size() <= 1) {
+        if (recv != send) std::memcpy(recv, send, size_t(nbytes));
+        return KF_OK;
+    }
+    // binary tree over root-rotated rank order
+    const int k = size();
+    Graph bcast(k);
+    auto at = [&](int pos) { return (pos + root) % k; };
+    for (int i = 0; i < k; i++)
+        for (int j : {2 * i + 1, 2 * i + 2})
+            if (j < k) bcast.add_edge(at(i), at(j));
+    Graph no_reduce(k);
+    return run_graphs((uint8_t *)recv, nbytes, dt, ROp::sum, no_reduce, bcast,
+                      name);
+}
+
+int Session::gather(const void *send, int64_t count, void *recv,
+                    int64_t total_count, Dtype dt, int root,
+                    const std::string &name) {
+    const size_t esz = dtype_size(dt);
+    const int64_t nbytes = count * int64_t(esz);
+    if (rank_ != root)
+        return send_chunk(root, name, (const uint8_t *)send, nbytes);
+    if (total_count < count * int64_t(size())) return KF_ERR_ARG;
+    std::memcpy((uint8_t *)recv + int64_t(rank_) * nbytes, send,
+                size_t(nbytes));
+    std::vector<uint8_t> incoming;
+    for (int r = 0; r < size(); r++) {
+        if (r == rank_) continue;
+        int rc = rdv_->pop(peers_[r], name, &incoming, timeout_ms_);
+        if (rc != KF_OK) return rc;
+        if (int64_t(incoming.size()) != nbytes) return KF_ERR;
+        std::memcpy((uint8_t *)recv + int64_t(r) * nbytes, incoming.data(),
+                    size_t(nbytes));
+    }
+    return KF_OK;
+}
+
+int Session::all_gather(const void *send, int64_t count, void *recv, Dtype dt,
+                        const std::string &name) {
+    const size_t esz = dtype_size(dt);
+    const int64_t nbytes = count * int64_t(esz);
+    std::memcpy((uint8_t *)recv + int64_t(rank_) * nbytes, send,
+                size_t(nbytes));
+    if (peers_.size() <= 1) return KF_OK;
+    // direct mesh: everyone sends its shard to everyone (reference:
+    // srcs/go/kungfu/session/allgather.go)
+    for (int r = 0; r < size(); r++) {
+        if (r == rank_) continue;
+        int rc = send_chunk(r, name, (const uint8_t *)send, nbytes);
+        if (rc != KF_OK) return rc;
+    }
+    std::vector<uint8_t> incoming;
+    for (int r = 0; r < size(); r++) {
+        if (r == rank_) continue;
+        int rc = rdv_->pop(peers_[r], name, &incoming, timeout_ms_);
+        if (rc != KF_OK) return rc;
+        if (int64_t(incoming.size()) != nbytes) return KF_ERR;
+        std::memcpy((uint8_t *)recv + int64_t(r) * nbytes, incoming.data(),
+                    size_t(nbytes));
+    }
+    return KF_OK;
+}
+
+int Session::barrier() {
+    uint8_t x = 0, y = 0;
+    return all_reduce(&x, &y, 1, Dtype::u8, ROp::sum, "kf::barrier");
+}
+
+int Session::consensus(const void *data, int64_t n, const std::string &name) {
+    // leaderless value agreement via paired MIN/MAX all-reduces: first on
+    // the length, then on the bytes (reference: session.go:105-136)
+    uint64_t len = uint64_t(n), lo = 0, hi = 0;
+    int rc = all_reduce(&len, &lo, 1, Dtype::u64, ROp::min, name + ":len:min");
+    if (rc != KF_OK) return rc;
+    rc = all_reduce(&len, &hi, 1, Dtype::u64, ROp::max, name + ":len:max");
+    if (rc != KF_OK) return rc;
+    if (lo != hi) return 0;
+    if (n == 0) return 1;
+    std::vector<uint8_t> mn(static_cast<size_t>(n));
+    std::vector<uint8_t> mx(static_cast<size_t>(n));
+    rc = all_reduce(data, mn.data(), n, Dtype::u8, ROp::min, name + ":min");
+    if (rc != KF_OK) return rc;
+    rc = all_reduce(data, mx.data(), n, Dtype::u8, ROp::max, name + ":max");
+    if (rc != KF_OK) return rc;
+    return mn == mx ? 1 : 0;
+}
+
+}  // namespace kf

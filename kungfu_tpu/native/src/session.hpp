@@ -1,0 +1,55 @@
+// Session: immutable per-epoch collective engine over the transport.
+// (Control-plane rebuild of reference srcs/go/kungfu/session.)
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "core.hpp"
+#include "transport.hpp"
+
+namespace kf {
+
+class Session {
+  public:
+    Session(PeerID self, std::vector<PeerID> peers, Strategy strategy,
+            Client *client, Rendezvous *rdv, int64_t timeout_ms);
+
+    int rank() const { return rank_; }
+    int size() const { return int(peers_.size()); }
+    int local_rank() const { return local_rank_; }
+    int local_size() const { return local_size_; }
+    const std::vector<PeerID> &peers() const { return peers_; }
+
+    int all_reduce(const void *send, void *recv, int64_t count, Dtype dt,
+                   ROp op, const std::string &name);
+    int reduce(const void *send, void *recv, int64_t count, Dtype dt, ROp op,
+               int root, const std::string &name);
+    int broadcast(const void *send, void *recv, int64_t count, Dtype dt,
+                  int root, const std::string &name);
+    int gather(const void *send, int64_t count, void *recv,
+               int64_t total_count, Dtype dt, int root,
+               const std::string &name);
+    int all_gather(const void *send, int64_t count, void *recv, Dtype dt,
+                   const std::string &name);
+    int barrier();
+    // 1 = all peers agree on these bytes, 0 = divergent, <0 = error
+    int consensus(const void *data, int64_t n, const std::string &name);
+
+  private:
+    // One chunk's reduce-then-broadcast walk over a (reduce, bcast) pair.
+    int run_graphs(uint8_t *chunk, int64_t nbytes, Dtype dt, ROp op,
+                   const Graph &rg, const Graph &bg, const std::string &name);
+    int send_chunk(int dst_rank, const std::string &name, const uint8_t *data,
+                   int64_t nbytes);
+
+    PeerID self_;
+    std::vector<PeerID> peers_;
+    int rank_ = -1, local_rank_ = 0, local_size_ = 1;
+    std::vector<GraphPair> strategies_;
+    Client *client_;
+    Rendezvous *rdv_;
+    int64_t timeout_ms_;
+};
+
+}  // namespace kf

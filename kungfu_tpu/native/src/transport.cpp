@@ -1,0 +1,473 @@
+#include "transport.hpp"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+
+#include "../include/kf.h"
+
+namespace kf {
+
+namespace {
+
+struct ConnHeader {
+    uint16_t type;
+    uint16_t src_port;
+    uint32_t src_ipv4;
+} __attribute__((packed));
+
+struct Ack {
+    uint32_t token;
+} __attribute__((packed));
+
+std::string rdv_key(const PeerID &src, const std::string &name) {
+    return src.str() + "|" + name;
+}
+
+int64_t now_us() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------- fd io
+
+bool read_exact(int fd, void *buf, size_t n) {
+    auto *p = static_cast<uint8_t *>(buf);
+    while (n > 0) {
+        ssize_t r = ::read(fd, p, n);
+        if (r <= 0) {
+            if (r < 0 && errno == EINTR) continue;
+            return false;
+        }
+        p += r;
+        n -= size_t(r);
+    }
+    return true;
+}
+
+bool write_exact(int fd, const void *buf, size_t n) {
+    auto *p = static_cast<const uint8_t *>(buf);
+    while (n > 0) {
+        ssize_t r = ::write(fd, p, n);
+        if (r <= 0) {
+            if (r < 0 && errno == EINTR) continue;
+            return false;
+        }
+        p += r;
+        n -= size_t(r);
+    }
+    return true;
+}
+
+bool write_message(int fd, const std::string &name, uint32_t flags,
+                   const void *data, size_t len) {
+    // single buffered write: header + name + flags + len + data
+    std::vector<uint8_t> buf;
+    buf.reserve(12 + name.size() + len);
+    auto put_u32 = [&](uint32_t v) {
+        buf.insert(buf.end(), (uint8_t *)&v, (uint8_t *)&v + 4);
+    };
+    put_u32(uint32_t(name.size()));
+    buf.insert(buf.end(), name.begin(), name.end());
+    put_u32(flags);
+    put_u32(uint32_t(len));
+    buf.insert(buf.end(), (const uint8_t *)data, (const uint8_t *)data + len);
+    return write_exact(fd, buf.data(), buf.size());
+}
+
+bool read_message(int fd, WireMessage *out, size_t max_len) {
+    uint32_t name_len;
+    if (!read_exact(fd, &name_len, 4)) return false;
+    if (name_len > 4096) return false;  // sanity: names are short
+    out->name.resize(name_len);
+    if (name_len && !read_exact(fd, out->name.data(), name_len)) return false;
+    if (!read_exact(fd, &out->flags, 4)) return false;
+    uint32_t len;
+    if (!read_exact(fd, &len, 4)) return false;
+    if (len > max_len) return false;
+    out->data.resize(len);
+    if (len && !read_exact(fd, out->data.data(), len)) return false;
+    return true;
+}
+
+// ------------------------------------------------------------- rendezvous
+
+void Rendezvous::push(const PeerID &src, WireMessage msg) {
+    std::lock_guard<std::mutex> lk(mu_);
+    q_[rdv_key(src, msg.name)].push_back(std::move(msg.data));
+    cv_.notify_all();
+}
+
+int Rendezvous::pop(const PeerID &src, const std::string &name,
+                    std::vector<uint8_t> *out, int64_t timeout_ms) {
+    const std::string key = rdv_key(src, name);
+    const bool stall_log = std::getenv("KF_STALL_DETECTION") != nullptr;
+    const auto t0 = std::chrono::steady_clock::now();
+    const auto deadline = t0 + std::chrono::milliseconds(timeout_ms);
+    auto next_stall_report = t0 + std::chrono::seconds(3);
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+        auto it = q_.find(key);
+        if (it != q_.end() && !it->second.empty()) {
+            *out = std::move(it->second.front());
+            it->second.pop_front();
+            if (it->second.empty()) q_.erase(it);
+            return KF_OK;
+        }
+        const auto now = std::chrono::steady_clock::now();
+        if (timeout_ms > 0 && now >= deadline) return KF_ERR_TIMEOUT;
+        if (stall_log && now >= next_stall_report) {
+            KF_WARN("recv of %s stalled for %lds", key.c_str(),
+                    long(std::chrono::duration_cast<std::chrono::seconds>(
+                             now - t0)
+                             .count()));
+            next_stall_report = now + std::chrono::seconds(3);
+        }
+        auto wake = now + std::chrono::seconds(3);  // stall-report tick
+        if (timeout_ms > 0 && deadline < wake) wake = deadline;
+        cv_.wait_until(lk, wake);
+    }
+}
+
+void Rendezvous::clear() {
+    std::lock_guard<std::mutex> lk(mu_);
+    q_.clear();
+}
+
+// ------------------------------------------------------------------ store
+
+int Store::save(const std::string &name, const void *data, int64_t n) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = blobs_.find(name);
+    if (it != blobs_.end() && int64_t(it->second.size()) != n)
+        return KF_ERR_ARG;  // size is immutable per name, like the reference
+    auto &blob = blobs_[name];
+    blob.assign((const uint8_t *)data, (const uint8_t *)data + n);
+    return KF_OK;
+}
+
+int Store::load(const std::string &name, std::vector<uint8_t> *out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = blobs_.find(name);
+    if (it == blobs_.end()) return KF_ERR_NOTFOUND;
+    *out = it->second;
+    return KF_OK;
+}
+
+int VersionedStore::save(const std::string &version, const std::string &name,
+                         const void *data, int64_t n) {
+    std::shared_ptr<Store> store;
+    {
+        std::lock_guard<std::mutex> lk(mu_);
+        for (auto &p : stores_)
+            if (p.first == version) store = p.second;
+        if (!store) {
+            store = std::make_shared<Store>();
+            stores_.emplace_back(version, store);
+            while (int(stores_.size()) > window_) stores_.pop_front();
+        }
+    }
+    return store->save(name, data, n);
+}
+
+int VersionedStore::load(const std::string &version, const std::string &name,
+                         std::vector<uint8_t> *out) {
+    std::shared_ptr<Store> store;
+    {
+        std::lock_guard<std::mutex> lk(mu_);
+        for (auto &p : stores_)
+            if (p.first == version) store = p.second;
+    }
+    if (!store) return KF_ERR_NOTFOUND;
+    return store->load(name, out);
+}
+
+// ----------------------------------------------------------------- client
+
+Client::~Client() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto &kv : conns_) {
+        std::lock_guard<std::mutex> clk(kv.second->mu);
+        if (kv.second->fd >= 0) ::close(kv.second->fd);
+        kv.second->fd = -1;
+    }
+    conns_.clear();
+}
+
+void Client::set_token(uint32_t token) { token_ = token; }
+
+int Client::dial(const PeerID &dest, ConnType t) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return KF_ERR_CONN;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(dest.port);
+    addr.sin_addr.s_addr = htonl(dest.ipv4);
+    if (::connect(fd, (sockaddr *)&addr, sizeof(addr)) != 0) {
+        ::close(fd);
+        return KF_ERR_CONN;
+    }
+    ConnHeader h{uint16_t(t), self_.port, self_.ipv4};
+    Ack ack{};
+    if (!write_exact(fd, &h, sizeof(h)) || !read_exact(fd, &ack, sizeof(ack))) {
+        ::close(fd);
+        return KF_ERR_CONN;
+    }
+    if (ack.token != token_.load() && t == ConnType::collective) {
+        // stale-epoch fence (reference: connection.go:81-87)
+        ::close(fd);
+        return KF_ERR_EPOCH;
+    }
+    return fd;
+}
+
+std::shared_ptr<Client::Conn> Client::get(const PeerID &dest, ConnType t) {
+    const uint64_t key = (dest.key() << 2) | uint64_t(t);
+    std::lock_guard<std::mutex> lk(mu_);
+    auto &c = conns_[key];
+    if (!c) c = std::make_shared<Conn>();
+    return c;
+}
+
+int Client::ensure_connected(Conn *c, const PeerID &dest, ConnType t) {
+    if (c->fd >= 0) return KF_OK;
+    int last = KF_ERR_CONN;
+    for (int i = 0; i <= connect_retries; i++) {
+        last = dial(dest, t);
+        if (last >= 0) break;
+        if (last == KF_ERR_EPOCH) return last;  // retrying won't help
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(connect_retry_ms));
+    }
+    if (last < 0) return last;
+    c->fd = last;
+    return KF_OK;
+}
+
+int Client::send(const PeerID &dest, ConnType t, const std::string &name,
+                 uint32_t flags, const void *data, size_t len) {
+    auto c = get(dest, t);
+    std::lock_guard<std::mutex> lk(c->mu);
+    // a pooled fd may have been kicked by the peer's epoch switch: one
+    // transparent re-dial on write failure
+    for (int attempt = 0; attempt < 2; attempt++) {
+        int rc = ensure_connected(c.get(), dest, t);
+        if (rc != KF_OK) return rc;
+        if (write_message(c->fd, name, flags, data, len)) {
+            counters_->egress += len;
+            return KF_OK;
+        }
+        ::close(c->fd);
+        c->fd = -1;
+    }
+    return KF_ERR_CONN;
+}
+
+int Client::request(const PeerID &dest, const std::string &version,
+                    const std::string &name, std::vector<uint8_t> *out) {
+    auto c = get(dest, ConnType::p2p);
+    std::lock_guard<std::mutex> lk(c->mu);
+    for (int attempt = 0; attempt < 2; attempt++) {
+        int rc = ensure_connected(c.get(), dest, ConnType::p2p);
+        if (rc != KF_OK) return rc;
+        // body carries the requested store version ("" = unversioned store)
+        WireMessage resp;
+        if (write_message(c->fd, name, 0, version.data(), version.size()) &&
+            read_message(c->fd, &resp) && (resp.flags & kFlagIsResponse)) {
+            if (resp.flags & kFlagRequestFailed) return KF_ERR_NOTFOUND;
+            counters_->ingress += resp.data.size();
+            *out = std::move(resp.data);
+            return KF_OK;
+        }
+        ::close(c->fd);
+        c->fd = -1;
+    }
+    return KF_ERR_CONN;
+}
+
+int Client::ping(const PeerID &dest, int64_t *rtt_us) {
+    // throwaway connection, like the reference's Ping
+    int64_t t0 = now_us();
+    int fd = dial(dest, ConnType::ping);
+    if (fd < 0) return fd;
+    if (!write_message(fd, "ping", 0, nullptr, 0)) {
+        ::close(fd);
+        return KF_ERR_CONN;
+    }
+    WireMessage echo;
+    bool ok = read_message(fd, &echo);
+    ::close(fd);
+    if (!ok) return KF_ERR_CONN;
+    if (rtt_us) *rtt_us = now_us() - t0;
+    return KF_OK;
+}
+
+void Client::reset(const std::vector<PeerID> &keep, uint32_t token) {
+    token_ = token;
+    std::unordered_set<uint64_t> keep_keys;
+    for (auto &p : keep) keep_keys.insert(p.key());
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto it = conns_.begin(); it != conns_.end();) {
+        const uint64_t peer_key = it->first >> 2;
+        const auto t = ConnType(it->first & 3);
+        // collective conns always reconnect under the new token; others
+        // survive only if the peer remains a member
+        const bool drop =
+            t == ConnType::collective || !keep_keys.count(peer_key);
+        if (drop) {
+            {
+                std::lock_guard<std::mutex> clk(it->second->mu);
+                if (it->second->fd >= 0) ::close(it->second->fd);
+                it->second->fd = -1;
+            }
+            it = conns_.erase(it);
+        } else {
+            ++it;
+        }
+    }
+}
+
+// ----------------------------------------------------------------- server
+
+int Server::start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return KF_ERR;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(self_.port);
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (::bind(listen_fd_, (sockaddr *)&addr, sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 128) != 0) {
+        KF_ERROR("bind/listen failed on %s: %s", self_.str().c_str(),
+                 std::strerror(errno));
+        ::close(listen_fd_);
+        listen_fd_ = -1;
+        return KF_ERR;
+    }
+    running_ = true;
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return KF_OK;
+}
+
+void Server::stop() {
+    if (!running_.exchange(false)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    // kick every reader out of its blocking read, then wait for the
+    // (detached) connection threads to drain
+    std::unique_lock<std::mutex> lk(mu_);
+    for (int fd : live_fds_) ::shutdown(fd, SHUT_RDWR);
+    conns_done_cv_.wait(lk, [this] { return active_conns_ == 0; });
+}
+
+void Server::drop_connections() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (int fd : live_fds_) ::shutdown(fd, SHUT_RDWR);
+}
+
+void Server::set_control_handler(ControlHandler h) {
+    std::lock_guard<std::mutex> lk(mu_);
+    control_handler_ = std::move(h);
+}
+
+void Server::set_request_handler(RequestHandler h) {
+    std::lock_guard<std::mutex> lk(mu_);
+    request_handler_ = std::move(h);
+}
+
+void Server::accept_loop() {
+    while (running_) {
+        int fd = ::accept(listen_fd_, nullptr, nullptr);
+        if (fd < 0) {
+            if (running_) continue;
+            break;
+        }
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        {
+            std::lock_guard<std::mutex> lk(mu_);
+            live_fds_.insert(fd);
+            active_conns_++;
+        }
+        // detached: reaped via active_conns_ in stop(); the fd is removed
+        // from live_fds_ BEFORE close so a recycled fd number can't be
+        // erased by a stale cleanup
+        std::thread([this, fd] {
+            serve_conn(fd);
+            std::unique_lock<std::mutex> lk(mu_);
+            live_fds_.erase(fd);
+            ::close(fd);
+            if (--active_conns_ == 0) conns_done_cv_.notify_all();
+        }).detach();
+    }
+}
+
+// NOTE: never closes fd — the accept_loop wrapper owns close, so the fd
+// number stays registered in live_fds_ until the instant it is released.
+void Server::serve_conn(int fd) {
+    ConnHeader h;
+    if (!read_exact(fd, &h, sizeof(h))) return;
+    Ack ack{token_.load()};
+    if (!write_exact(fd, &ack, sizeof(ack))) return;
+    const PeerID src{h.src_ipv4, h.src_port};
+    const auto t = ConnType(h.type);
+    WireMessage msg;
+    while (running_ && read_message(fd, &msg)) {
+        counters_->ingress += msg.data.size();
+        switch (t) {
+            case ConnType::collective:
+                rdv_->push(src, std::move(msg));
+                break;
+            case ConnType::p2p: {
+                RequestHandler handler;
+                {
+                    std::lock_guard<std::mutex> lk(mu_);
+                    handler = request_handler_;
+                }
+                std::vector<uint8_t> blob;
+                int rc = KF_ERR_NOTFOUND;
+                if (handler) {
+                    std::string version(msg.data.begin(), msg.data.end());
+                    rc = handler(version, msg.name, &blob);
+                }
+                uint32_t flags = kFlagIsResponse;
+                if (rc != KF_OK) flags |= kFlagRequestFailed;
+                if (!write_message(fd, msg.name, flags, blob.data(),
+                                   blob.size()))
+                    return;
+                counters_->egress += blob.size();
+                break;
+            }
+            case ConnType::control: {
+                ControlHandler handler;
+                {
+                    std::lock_guard<std::mutex> lk(mu_);
+                    handler = control_handler_;
+                }
+                if (handler) handler(msg.name, msg.data);
+                break;
+            }
+            case ConnType::ping:
+                if (!write_message(fd, msg.name, 0, msg.data.data(),
+                                   msg.data.size()))
+                    return;
+                break;
+        }
+        msg = WireMessage{};
+    }
+}
+
+}  // namespace kf

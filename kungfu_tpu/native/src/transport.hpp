@@ -1,0 +1,199 @@
+// TCP transport: framed named messages, connection pool, server,
+// collective rendezvous, blob store.
+// (Control-plane rebuild of reference srcs/go/rchannel + srcs/go/store.)
+//
+// Wire protocol (all integers little-endian):
+//   on connect:  ConnHeader { u16 type, u16 src_port, u32 src_ipv4 }
+//   server ack:  Ack        { u32 token }   -- token = cluster epoch; a
+//                Collective dial whose token mismatches the dialer's epoch
+//                is rejected (stale-epoch fencing).
+//   then a stream of messages:
+//                MsgHeader  { u32 name_len, name bytes, u32 flags }
+//                Body       { u32 len, data }
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "core.hpp"
+
+namespace kf {
+
+enum class ConnType : uint16_t {
+    ping = 0,
+    control = 1,
+    collective = 2,
+    p2p = 3,
+};
+
+// message flags
+constexpr uint32_t kFlagIsResponse = 1u << 1;
+constexpr uint32_t kFlagRequestFailed = 1u << 2;
+
+struct WireMessage {
+    std::string name;
+    uint32_t flags = 0;
+    std::vector<uint8_t> data;
+};
+
+// ------------------------------------------------------------------- fd io
+
+// Blocking exact-length read/write on a socket fd; false on EOF/error.
+bool read_exact(int fd, void *buf, size_t n);
+bool write_exact(int fd, const void *buf, size_t n);
+bool write_message(int fd, const std::string &name, uint32_t flags,
+                   const void *data, size_t len);
+// max_len guards allocations against corrupt/hostile length prefixes
+bool read_message(int fd, WireMessage *out, size_t max_len = size_t(1) << 33);
+
+// ------------------------------------------------------------- rendezvous
+
+// Named FIFO mailboxes for collective traffic: key = (src peer, tensor
+// name). FIFO per key matches per-connection message order, which is what
+// makes reduce-phase and bcast-phase messages on the same name unambiguous.
+class Rendezvous {
+  public:
+    void push(const PeerID &src, WireMessage msg);
+    // Blocks until a message for (src,name) arrives; KF_OK / KF_ERR_TIMEOUT.
+    int pop(const PeerID &src, const std::string &name,
+            std::vector<uint8_t> *out, int64_t timeout_ms);
+    void clear();
+
+  private:
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::unordered_map<std::string, std::deque<std::vector<uint8_t>>> q_;
+};
+
+// ------------------------------------------------------------------ store
+
+// Named blobs; size-checked on re-save like the reference store.
+class Store {
+  public:
+    int save(const std::string &name, const void *data, int64_t n);
+    // returns KF_OK and copies into out (must be exact size), or
+    // KF_ERR_NOTFOUND / KF_ERR_ARG on size mismatch
+    int load(const std::string &name, std::vector<uint8_t> *out);
+
+  private:
+    std::mutex mu_;
+    std::unordered_map<std::string, std::vector<uint8_t>> blobs_;
+};
+
+// Sliding window of `window` versioned stores (reference keeps 3 so async
+// peers can fetch slightly-stale models while new ones are written).
+class VersionedStore {
+  public:
+    explicit VersionedStore(int window = 3) : window_(window) {}
+    int save(const std::string &version, const std::string &name,
+             const void *data, int64_t n);
+    int load(const std::string &version, const std::string &name,
+             std::vector<uint8_t> *out);
+
+  private:
+    int window_;
+    std::mutex mu_;
+    std::deque<std::pair<std::string, std::shared_ptr<Store>>> stores_;
+};
+
+// ----------------------------------------------------------------- client
+
+struct Counters {
+    std::atomic<uint64_t> egress{0}, ingress{0};
+};
+
+// Connection pool: one persistent connection per (dest, type). Sends are
+// serialized per connection; P2P request/response holds the connection lock
+// across the round trip.
+class Client {
+  public:
+    Client(PeerID self, Counters *counters)
+        : self_(self), counters_(counters) {}
+    ~Client();
+
+    void set_token(uint32_t token);
+    // send framed message; establishes the connection on first use
+    int send(const PeerID &dest, ConnType t, const std::string &name,
+             uint32_t flags, const void *data, size_t len);
+    // P2P RPC: request blob `name` (body = version string, may be empty)
+    int request(const PeerID &dest, const std::string &version,
+                const std::string &name, std::vector<uint8_t> *out);
+    int ping(const PeerID &dest, int64_t *rtt_us);
+    // Drop connections to peers outside `keep` and adopt the new token.
+    void reset(const std::vector<PeerID> &keep, uint32_t token);
+
+    int connect_retries = 120;      // x period = dial patience for peers
+    int connect_retry_ms = 250;     // that are still starting up
+
+  private:
+    struct Conn {
+        std::mutex mu;
+        int fd = -1;
+    };
+    std::shared_ptr<Conn> get(const PeerID &dest, ConnType t);
+    int dial(const PeerID &dest, ConnType t);  // returns fd or negative err
+    int ensure_connected(Conn *c, const PeerID &dest, ConnType t);
+
+    PeerID self_;
+    Counters *counters_;
+    std::mutex mu_;
+    std::atomic<uint32_t> token_{0};
+    std::unordered_map<uint64_t, std::shared_ptr<Conn>> conns_;
+};
+
+// ----------------------------------------------------------------- server
+
+using ControlHandler =
+    std::function<void(const std::string &name, const std::vector<uint8_t> &)>;
+// Resolve a P2P request to blob bytes; returns KF_OK or KF_ERR_NOTFOUND.
+using RequestHandler = std::function<int(
+    const std::string &version, const std::string &name,
+    std::vector<uint8_t> *out)>;
+
+// Accept loop + one reader thread per connection. Collective messages land
+// in the Rendezvous; P2P requests are answered inline on the same socket;
+// Control messages invoke the handler; Pings echo.
+class Server {
+  public:
+    Server(PeerID self, Rendezvous *rdv, Counters *counters)
+        : self_(self), rdv_(rdv), counters_(counters) {}
+    ~Server() { stop(); }
+
+    int start();
+    void stop();
+    void set_token(uint32_t token) { token_ = token; }
+    // Kick every live connection (used at epoch switch so stale-epoch
+    // senders must re-handshake against the new token).
+    void drop_connections();
+    void set_control_handler(ControlHandler h);
+    void set_request_handler(RequestHandler h);
+
+  private:
+    void accept_loop();
+    void serve_conn(int fd);
+
+    PeerID self_;
+    Rendezvous *rdv_;
+    Counters *counters_;
+    std::atomic<uint32_t> token_{0};
+    std::atomic<bool> running_{false};
+    int listen_fd_ = -1;
+    std::thread accept_thread_;
+    std::mutex mu_;
+    std::condition_variable conns_done_cv_;
+    int active_conns_ = 0;
+    ControlHandler control_handler_;
+    RequestHandler request_handler_;
+    std::unordered_set<int> live_fds_;
+};
+
+}  // namespace kf

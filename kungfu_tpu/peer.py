@@ -1,0 +1,254 @@
+"""Process-level Peer: control-plane lifecycle + elastic membership.
+
+Wraps the native libkf peer with the cluster-level logic the reference keeps
+in Go (reference: srcs/go/kungfu/peer/peer.go): lazy session, digest
+consensus before any membership switch, runner notification, and the
+config-server-driven resize loop. The TPU data plane (JAX mesh) is layered
+separately in kungfu_tpu.parallel — this class is pure DCN control.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Optional, Tuple
+
+from . import env as kfenv
+from .ffi import NativePeer
+from .plan import Cluster, PeerID, PeerList
+
+
+class Stage:
+    """A versioned cluster snapshot — the config-server wire unit
+    (reference: srcs/go/kungfu/runner/handler.go:18-36)."""
+
+    def __init__(self, version: int, cluster: Cluster):
+        self.version = version
+        self.cluster = cluster
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "version": self.version,
+                "cluster": json.loads(self.cluster.to_json()),
+            }
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "Stage":
+        d = json.loads(s)
+        return cls(
+            version=int(d["version"]),
+            cluster=Cluster.from_json(json.dumps(d["cluster"])),
+        )
+
+    def digest(self) -> bytes:
+        return self.version.to_bytes(4, "little") + self.cluster.to_bytes()
+
+
+def fetch_url(url: str, timeout: float = 5.0) -> str:
+    """GET text from http(s):// or file:// URLs (tests use file://)."""
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def put_url(url: str, body: str, timeout: float = 5.0) -> None:
+    req = urllib.request.Request(
+        url, data=body.encode(), method="PUT",
+        headers={"Content-Type": "application/json"},
+    )
+    urllib.request.urlopen(req, timeout=timeout).read()
+
+
+class Peer:
+    """One worker's control-plane endpoint.
+
+    Usually constructed from the KF_* env protocol (`Peer()`), which the
+    kfrun launcher populates; without it the process is a standalone
+    single-worker cluster.
+    """
+
+    def __init__(self, config: Optional[kfenv.Config] = None):
+        self.config = config or kfenv.from_env()
+        self._workers = self.config.init_peers
+        self._version = self.config.version
+        self._started = False
+        if self.config.single_process:
+            self._native = None
+        else:
+            self._native = NativePeer(
+                str(self.config.self_id),
+                str(self._workers),
+                version=self._version,
+                strategy=self.config.strategy,
+                timeout_ms=self.config.timeout_ms or 300_000,
+            )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Peer":
+        if self._started:
+            return self
+        if self._native is not None:
+            self._native.start()
+            # reference blocks in updateTo's Barrier until the whole
+            # cluster is up (peer.go:137-159)
+            self._native.barrier()
+        self._started = True
+        return self
+
+    def stop(self):
+        if self._native is not None:
+            self._native.stop()
+        self._started = False
+
+    def close(self):
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def rank(self) -> int:
+        return 0 if self._native is None else self._native.rank
+
+    @property
+    def size(self) -> int:
+        return 1 if self._native is None else self._native.size
+
+    @property
+    def local_rank(self) -> int:
+        return 0 if self._native is None else self._native.local_rank
+
+    @property
+    def local_size(self) -> int:
+        return 1 if self._native is None else self._native.local_size
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    @property
+    def uid(self) -> int:
+        return self.config.self_id.uid(self.config.version)
+
+    @property
+    def workers(self) -> PeerList:
+        return self._workers
+
+    # -- collectives / store (control plane) --------------------------------
+
+    def barrier(self):
+        if self._native is not None:
+            self._native.barrier()
+
+    def all_reduce(self, x, op="sum", name=""):
+        return x.copy() if self._native is None else self._native.all_reduce(
+            x, op=op, name=name)
+
+    def broadcast(self, x, root=0, name=""):
+        return x.copy() if self._native is None else self._native.broadcast(
+            x, root=root, name=name)
+
+    def all_gather(self, x, name=""):
+        if self._native is None:
+            return x[None, ...].copy()
+        return self._native.all_gather(x, name=name)
+
+    def consensus(self, data: bytes, name: str = "consensus") -> bool:
+        return True if self._native is None else self._native.consensus(
+            data, name=name)
+
+    def save(self, name, x, version=None):
+        if self._native is not None:
+            self._native.save(name, x, version=version)
+
+    def request(self, rank, name, like, version=None):
+        if self._native is None:
+            raise RuntimeError("request() needs a multi-process cluster")
+        return self._native.request(rank, name, like, version=version)
+
+    def ping(self, rank) -> int:
+        return 0 if self._native is None else self._native.ping(rank)
+
+    def stats(self):
+        if self._native is None:
+            return {"egress_bytes": 0, "ingress_bytes": 0}
+        return self._native.stats()
+
+    def latencies(self):
+        """RTT (us) to every peer; 0 for self. (reference:
+        srcs/go/kungfu/session/monitoring.go)"""
+        return [0 if r == self.rank else self.ping(r)
+                for r in range(self.size)]
+
+    # -- elastic membership --------------------------------------------------
+
+    def resize_from_url(self, url: str = "") -> Tuple[bool, bool]:
+        """Poll the config server and, on an agreed new cluster, switch epoch.
+
+        Returns (changed, keep): `changed` = a new epoch was adopted;
+        `keep` = this worker remains a member (if False the caller should
+        exit and let the runner reap it). Mirrors the reference's
+        ResizeClusterFromURL consensus-retry loop (peer.go:208-233).
+        """
+        url = url or self.config.config_server
+        if not url:
+            return False, True
+        if self._native is None:
+            return False, True
+        while True:
+            stage = Stage.from_json(fetch_url(url))
+            if stage.version == self._version:
+                return False, True
+            # all current members must observe the same proposal before
+            # anyone switches — digest consensus over the control plane
+            if self.consensus(stage.digest(), name=f"resize:{stage.version}"):
+                break
+            time.sleep(0.05)
+        return self._propose(stage)
+
+    def _propose(self, stage: Stage) -> Tuple[bool, bool]:
+        new_workers = stage.cluster.workers
+        keep = new_workers.rank(self.config.self_id) is not None
+        if self._workers.disjoint(new_workers):
+            print("[kf] WARNING: new cluster disjoint from old; "
+                  "training state will be lost", flush=True)
+        # tell every runner to reconcile its local workers for this stage
+        payload = stage.to_json().encode()
+        for runner in stage.cluster.runners:
+            try:
+                self._native.send_control(str(runner), "update", payload)
+            except Exception as e:  # a dead runner must not block resize
+                print(f"[kf] notify runner {runner} failed: {e}", flush=True)
+        old_workers = self._workers
+        # adopt the epoch in Python state only once the native switch (and
+        # the join barrier) succeeded — otherwise a failed/timed-out join
+        # would leave this worker believing it reached an epoch it never
+        # entered, wedging every later resize poll
+        if keep:
+            self._native.update(str(new_workers), stage.version)
+            self._native.barrier()
+        else:
+            # fence: leave the old epoch so stale sends fail fast
+            self._native.update(str(PeerList([self.config.self_id])),
+                                stage.version)
+        self._version = stage.version
+        self._workers = new_workers
+        changed = not old_workers == new_workers
+        return changed, keep
+
+    def propose_new_size(self, new_size: int, url: str = ""):
+        """Resize the current cluster spec and PUT it to the config server
+        (reference: srcs/go/kungfu/peer/legacy.go:19-45)."""
+        url = url or self.config.config_server
+        if not url:
+            raise RuntimeError("no config server configured")
+        get_url = url
+        put_target = url.replace("/get", "/put")
+        stage = Stage.from_json(fetch_url(get_url))
+        new_cluster = stage.cluster.resize(new_size)
+        new_stage = Stage(version=stage.version + 1, cluster=new_cluster)
+        put_url(put_target, new_stage.to_json())

@@ -1,0 +1,374 @@
+"""Integration tests for libkf, the C++ DCN control plane.
+
+Strategy mirrors the reference's fake-trainer/in-proc harness (reference:
+tests/cpp/integration/fake_in_proc_trainer, scripts/tests/run-integration-
+tests.sh): N peers live in one process on distinct loopback ports, each
+driven from its own thread, and every collective result is checked against
+a locally computed expectation. Covers all topologies x np, dtypes incl.
+f16, multi-chunk buffers, P2P store, consensus, and epoch-fenced updates.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.ffi import KF_ERR_NOTFOUND, KfError, NativePeer
+
+BASE_PORT = 21000
+_port_lock = threading.Lock()
+_next_port = [BASE_PORT]
+
+
+def alloc_ports(n):
+    with _port_lock:
+        lo = _next_port[0]
+        _next_port[0] += n
+    return list(range(lo, lo + n))
+
+
+def make_cluster(np_, strategy="AUTO", timeout_ms=20000):
+    ports = alloc_ports(np_)
+    spec = ",".join(f"127.0.0.1:{p}" for p in ports)
+    peers = [
+        NativePeer(f"127.0.0.1:{p}", spec, version=0, strategy=strategy,
+                   timeout_ms=timeout_ms)
+        for p in ports
+    ]
+    for p in peers:
+        p.start()
+    return peers
+
+
+def run_on_all(peers, fn):
+    """Run fn(peer, rank) on one thread per peer; re-raise first error."""
+    results = [None] * len(peers)
+    errors = []
+
+    def work(i):
+        try:
+            results[i] = fn(peers[i], i)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(len(peers))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+def shutdown(peers):
+    for p in peers:
+        p.close()
+
+
+class TestBasics:
+    def test_single_peer_fallback(self):
+        (p,) = make_cluster(1)
+        try:
+            assert (p.rank, p.size, p.local_rank, p.local_size) == (0, 1, 0, 1)
+            x = np.arange(10, dtype=np.float32)
+            np.testing.assert_array_equal(p.all_reduce(x), x)
+            p.barrier()
+            assert p.consensus(b"solo")
+        finally:
+            shutdown([p])
+
+    def test_rank_and_locality(self):
+        peers = make_cluster(4)
+        try:
+            for i, p in enumerate(peers):
+                assert p.rank == i
+                assert p.size == 4
+                assert p.local_size == 4  # all on 127.0.0.1
+                assert p.local_rank == i
+        finally:
+            shutdown(peers)
+
+
+@pytest.mark.parametrize("strategy", ["STAR", "RING", "CLIQUE", "TREE",
+                                      "BINARY_TREE", "BINARY_TREE_STAR",
+                                      "MULTI_BINARY_TREE_STAR", "AUTO"])
+@pytest.mark.parametrize("np_", [2, 4])
+def test_all_reduce_strategies(strategy, np_):
+    peers = make_cluster(np_, strategy=strategy)
+    try:
+        n = 1000
+
+        def work(p, rank):
+            x = np.full(n, float(rank + 1), dtype=np.float32)
+            return p.all_reduce(x, name=f"grad:{strategy}")
+
+        expected = np.full(n, sum(range(1, np_ + 1)), dtype=np.float32)
+        for r in run_on_all(peers, work):
+            np.testing.assert_array_equal(r, expected)
+    finally:
+        shutdown(peers)
+
+
+class TestAllReduceVariants:
+    def setup_method(self, _):
+        self.peers = make_cluster(4)
+
+    def teardown_method(self, _):
+        shutdown(self.peers)
+
+    @pytest.mark.parametrize("op,expect", [
+        ("sum", 0 + 1 + 2 + 3), ("min", 0), ("max", 3), ("prod", 0),
+    ])
+    def test_ops(self, op, expect):
+        def work(p, rank):
+            x = np.full(16, float(rank), dtype=np.float64)
+            return p.all_reduce(x, op=op, name=f"op:{op}")
+
+        for r in run_on_all(self.peers, work):
+            np.testing.assert_array_equal(
+                r, np.full(16, float(expect), dtype=np.float64))
+
+    @pytest.mark.parametrize("dtype", [np.int32, np.int64, np.uint8,
+                                       np.float16, np.float32, np.float64])
+    def test_dtypes(self, dtype):
+        def work(p, rank):
+            x = np.full(64, rank + 1, dtype=dtype)
+            return p.all_reduce(x, name=f"dt:{np.dtype(dtype).name}")
+
+        for r in run_on_all(self.peers, work):
+            np.testing.assert_array_equal(r, np.full(64, 10, dtype=dtype))
+
+    def test_multi_chunk_large_buffer(self):
+        # >1MiB forces the chunked multi-graph path
+        n = (1 << 20) // 4 * 3 + 17  # ~3MiB of f32, odd remainder
+        def work(p, rank):
+            x = np.arange(n, dtype=np.float32) * (rank + 1)
+            return p.all_reduce(x, name="big")
+
+        expected = np.arange(n, dtype=np.float32) * 10
+        for r in run_on_all(self.peers, work):
+            np.testing.assert_array_equal(r, expected)
+
+    def test_concurrent_named_ops(self):
+        # two collectives in flight per peer, issued in different order on
+        # different ranks — must not deadlock (shared session lock)
+        def work(p, rank):
+            names = ["a", "b"] if rank % 2 == 0 else ["b", "a"]
+            outs = {}
+            ts = []
+            for nm in names:
+                def go(nm=nm):
+                    x = np.full(8, float(rank), dtype=np.float32)
+                    outs[nm] = p.all_reduce(x, name=nm)
+                ts.append(threading.Thread(target=go))
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return outs
+
+        for outs in run_on_all(self.peers, work):
+            for nm in ("a", "b"):
+                np.testing.assert_array_equal(
+                    outs[nm], np.full(8, 6.0, dtype=np.float32))
+
+
+class TestOtherCollectives:
+    def setup_method(self, _):
+        self.peers = make_cluster(4)
+
+    def teardown_method(self, _):
+        shutdown(self.peers)
+
+    def test_broadcast_from_nonzero_root(self):
+        def work(p, rank):
+            x = (np.arange(32, dtype=np.float32) if rank == 2
+                 else np.zeros(32, dtype=np.float32))
+            return p.broadcast(x, root=2, name="bc")
+
+        for r in run_on_all(self.peers, work):
+            np.testing.assert_array_equal(r, np.arange(32, dtype=np.float32))
+
+    def test_reduce_to_root(self):
+        def work(p, rank):
+            x = np.full(8, float(rank + 1), dtype=np.float32)
+            return p.reduce(x, root=1, name="red")
+
+        results = run_on_all(self.peers, work)
+        np.testing.assert_array_equal(
+            results[1], np.full(8, 10.0, dtype=np.float32))
+        assert results[0] is None and results[2] is None  # non-root ranks
+
+    def test_gather(self):
+        def work(p, rank):
+            x = np.full(4, float(rank), dtype=np.float32)
+            return p.gather(x, root=0, name="gth")
+
+        results = run_on_all(self.peers, work)
+        assert results[1] is None
+        np.testing.assert_array_equal(
+            results[0],
+            np.stack([np.full(4, float(r), dtype=np.float32)
+                      for r in range(4)]),
+        )
+
+    def test_all_gather(self):
+        def work(p, rank):
+            x = np.array([rank * 10, rank * 10 + 1], dtype=np.int32)
+            return p.all_gather(x, name="ag")
+
+        expected = np.array([[0, 1], [10, 11], [20, 21], [30, 31]],
+                            dtype=np.int32)
+        for r in run_on_all(self.peers, work):
+            np.testing.assert_array_equal(r, expected)
+
+    def test_barrier(self):
+        order = []
+
+        def work(p, rank):
+            p.barrier()
+            order.append(rank)
+            p.barrier()
+            return len(order)
+
+        results = run_on_all(self.peers, work)
+        # after second barrier everyone saw all four arrivals
+        assert all(r == 4 for r in results)
+
+    def test_consensus_agree_and_diverge(self):
+        def agree(p, rank):
+            return p.consensus(b"epoch-1", name="c1")
+
+        assert all(run_on_all(self.peers, agree))
+
+        def diverge(p, rank):
+            return p.consensus(f"epoch-{rank % 2}".encode(), name="c2")
+
+        assert not any(run_on_all(self.peers, diverge))
+
+    def test_consensus_divergent_lengths(self):
+        def work(p, rank):
+            return p.consensus(b"x" * (rank + 1), name="c3")
+
+        assert not any(run_on_all(self.peers, work))
+
+    def test_ping(self):
+        rtt = self.peers[0].ping(1)
+        assert 0 <= rtt < 1_000_000
+
+    def test_stats_counts_traffic(self):
+        def work(p, rank):
+            return p.all_reduce(np.ones(1000, dtype=np.float32), name="st")
+
+        run_on_all(self.peers, work)
+        stats = [p.stats() for p in self.peers]
+        assert sum(s["egress_bytes"] for s in stats) > 0
+        assert sum(s["ingress_bytes"] for s in stats) > 0
+
+
+class TestP2P:
+    def setup_method(self, _):
+        self.peers = make_cluster(3)
+
+    def teardown_method(self, _):
+        shutdown(self.peers)
+
+    def test_save_request(self):
+        model = np.arange(100, dtype=np.float32)
+        self.peers[1].save("model", model)
+        got = self.peers[0].request(1, "model", like=model)
+        np.testing.assert_array_equal(got, model)
+
+    def test_request_missing_blob(self):
+        with pytest.raises(KfError) as ei:
+            self.peers[0].request(1, "nope", like=np.zeros(4, np.float32))
+        assert ei.value.code == KF_ERR_NOTFOUND
+
+    def test_versioned_store_window(self):
+        x = np.zeros(8, dtype=np.float32)
+        for v in range(5):
+            self.peers[2].save("w", x + v, version=str(v))
+        # window is 3: versions 2,3,4 live; 0,1 evicted
+        got = self.peers[0].request(2, "w", like=x, version="4")
+        np.testing.assert_array_equal(got, x + 4)
+        got = self.peers[0].request(2, "w", like=x, version="2")
+        np.testing.assert_array_equal(got, x + 2)
+        with pytest.raises(KfError) as ei:
+            self.peers[0].request(2, "w", like=x, version="0")
+        assert ei.value.code == KF_ERR_NOTFOUND
+
+    def test_save_size_immutable(self):
+        self.peers[0].save("blob", np.zeros(8, dtype=np.float32))
+        with pytest.raises(KfError):
+            self.peers[0].save("blob", np.zeros(9, dtype=np.float32))
+
+
+class TestControlChannel:
+    def test_control_roundtrip(self):
+        ports = alloc_ports(2)
+        spec = ",".join(f"127.0.0.1:{p}" for p in ports)
+        a = NativePeer(f"127.0.0.1:{ports[0]}", spec, timeout_ms=10000)
+        b = NativePeer(f"127.0.0.1:{ports[1]}", spec, timeout_ms=10000)
+        a.start()
+        b.start()
+        try:
+            ev = threading.Event()
+            seen = {}
+
+            def handler(name, payload):
+                seen["msg"] = (name, payload)
+                ev.set()
+
+            b.set_control_handler(handler)
+            a.send_control(f"127.0.0.1:{ports[1]}", "update",
+                           b'{"version": 2}')
+            assert ev.wait(5.0)
+            assert seen["msg"] == ("update", b'{"version": 2}')
+        finally:
+            a.close()
+            b.close()
+
+
+def test_update_epoch_shrink_and_regrow():
+    ports = alloc_ports(4)
+    spec4 = ",".join(f"127.0.0.1:{p}" for p in ports)
+    spec3 = ",".join(f"127.0.0.1:{p}" for p in ports[:3])
+    peers = [NativePeer(f"127.0.0.1:{p}", spec4, version=0,
+                        timeout_ms=20000) for p in ports]
+    for p in peers:
+        p.start()
+    try:
+        def work0(p, rank):
+            return p.all_reduce(np.full(4, 1.0, dtype=np.float32), name="e0")
+
+        for r in run_on_all(peers, work0):
+            np.testing.assert_array_equal(r, np.full(4, 4.0, np.float32))
+
+        # epoch 1: drop rank 3
+        survivors = peers[:3]
+        for p in survivors:
+            p.update(spec3, 1)
+        assert all(p.version == 1 for p in survivors)
+        assert all(p.size == 3 for p in survivors)
+
+        def work1(p, rank):
+            return p.all_reduce(np.full(4, 1.0, dtype=np.float32), name="e1")
+
+        for r in run_on_all(survivors, work1):
+            np.testing.assert_array_equal(r, np.full(4, 3.0, np.float32))
+
+        # epoch 2: regrow to 4 (rank 3 rejoins with matching epoch)
+        for p in peers[:3]:
+            p.update(spec4, 2)
+        peers[3].update(spec4, 2)
+
+        def work2(p, rank):
+            return p.all_reduce(np.full(4, 1.0, dtype=np.float32), name="e2")
+
+        for r in run_on_all(peers, work2):
+            np.testing.assert_array_equal(r, np.full(4, 4.0, np.float32))
+    finally:
+        for p in peers:
+            p.close()

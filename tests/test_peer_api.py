@@ -1,0 +1,122 @@
+"""Tests for the Python core API: env protocol + Peer lifecycle.
+
+Multi-process behavior is covered by test_control_plane (in-proc peers) and
+test_launcher (real subprocesses); here we check env parsing, the
+single-process fallback, and the multi-peer Python Peer built from explicit
+configs on loopback ports.
+"""
+
+import threading
+
+import numpy as np
+
+import kungfu_tpu
+from kungfu_tpu import env as kfenv
+from kungfu_tpu.peer import Peer
+from kungfu_tpu.plan import PeerID, PeerList
+
+
+class TestEnvProtocol:
+    def test_single_process_fallback(self):
+        cfg = kfenv.from_env({})
+        assert cfg.single_process
+        assert cfg.rank == 0
+        assert len(cfg.init_peers) == 1
+
+    def test_full_env(self):
+        e = {
+            kfenv.SELF_SPEC: "127.0.0.1:10001",
+            kfenv.INIT_PEERS: "127.0.0.1:10000,127.0.0.1:10001",
+            kfenv.INIT_CLUSTER_VERSION: "3",
+            kfenv.ALLREDUCE_STRATEGY: "RING",
+            kfenv.PARENT_ID: "127.0.0.1:38080",
+            kfenv.CONFIG_SERVER: "http://127.0.0.1:9100/get",
+        }
+        cfg = kfenv.from_env(e)
+        assert not cfg.single_process
+        assert cfg.rank == 1
+        assert cfg.version == 3
+        assert cfg.strategy == "RING"
+        assert cfg.parent == PeerID.parse("127.0.0.1:38080")
+        assert cfg.config_server.endswith("/get")
+
+    def test_worker_env_roundtrip(self):
+        peers = PeerList.parse("127.0.0.1:10000,127.0.0.1:10001")
+        env = kfenv.worker_env(
+            peers[1], peers, version=2, strategy="STAR",
+            parent=PeerID.parse("127.0.0.1:38080"),
+        )
+        cfg = kfenv.from_env(env)
+        assert cfg.rank == 1
+        assert cfg.version == 2
+        assert cfg.strategy == "STAR"
+        assert cfg.init_peers == peers
+
+
+class TestSingleProcessPeer:
+    def test_top_level_api(self):
+        assert kungfu_tpu.current_rank() == 0
+        assert kungfu_tpu.current_cluster_size() == 1
+        assert kungfu_tpu.current_local_rank() == 0
+        assert kungfu_tpu.current_local_size() == 1
+        kungfu_tpu.barrier()  # no-op
+
+    def test_collectives_identity(self):
+        p = kungfu_tpu.peer()
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_array_equal(p.all_reduce(x), x)
+        np.testing.assert_array_equal(p.broadcast(x), x)
+        np.testing.assert_array_equal(p.all_gather(x), x[None])
+        assert p.consensus(b"anything")
+
+
+def make_peer_cluster(n, base_port):
+    peers = PeerList.parse(
+        ",".join(f"127.0.0.1:{base_port + i}" for i in range(n)))
+    cfgs = [
+        kfenv.Config(self_id=peers[i], init_peers=peers, version=0,
+                     timeout_ms=20000)
+        for i in range(n)
+    ]
+    return [Peer(c) for c in cfgs]
+
+
+def run_on_all(peers, fn):
+    results = [None] * len(peers)
+    errors = []
+
+    def work(i):
+        try:
+            results[i] = fn(peers[i], i)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(len(peers))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        raise errors[0]
+    return results
+
+
+class TestMultiPeer:
+    def test_start_barrier_allreduce(self):
+        peers = make_peer_cluster(3, 22000)
+        try:
+            run_on_all(peers, lambda p, i: p.start())
+            def work(p, rank):
+                return p.all_reduce(
+                    np.full(4, float(rank + 1), dtype=np.float32), name="w")
+
+            for r in run_on_all(peers, work):
+                np.testing.assert_array_equal(
+                    r, np.full(4, 6.0, dtype=np.float32))
+            assert [p.uid for p in peers] == sorted(set(
+                p.uid for p in peers))
+            lat = peers[0].latencies()
+            assert lat[0] == 0 and all(v >= 0 for v in lat)
+        finally:
+            for p in peers:
+                p.close()
