@@ -1,0 +1,50 @@
+"""TPU data-plane collective ops.
+
+These are the ICI-native equivalents of the reference's TF custom ops
+(reference: srcs/cpp/src/tensorflow/ops/, srcs/python/kungfu/tensorflow/ops/):
+pure-JAX functions meant to run inside `shard_map`/`pmap` over a named mesh
+axis, where XLA compiles them onto the ICI interconnect. There is no
+order-group scheduler here — SPMD compilation fixes the collective order on
+every chip, which dissolves the reference's NCCL-order machinery by design
+(SURVEY §5.8).
+"""
+
+from .collective import (
+    all_gather,
+    all_reduce,
+    all_reduce_mean,
+    broadcast,
+    defuse,
+    fuse,
+    group_all_reduce,
+    neighbor_exchange,
+    ring_neighbor,
+    subtree_shapes,
+)
+from .monitor import (
+    GradNoiseScaleState,
+    gradient_variance,
+    init_noise_scale,
+    tree_sq_norm,
+    update_noise_scale,
+    update_noise_scale_from_sq,
+)
+
+__all__ = [
+    "all_reduce",
+    "all_reduce_mean",
+    "group_all_reduce",
+    "broadcast",
+    "all_gather",
+    "fuse",
+    "defuse",
+    "subtree_shapes",
+    "ring_neighbor",
+    "neighbor_exchange",
+    "GradNoiseScaleState",
+    "init_noise_scale",
+    "update_noise_scale",
+    "update_noise_scale_from_sq",
+    "tree_sq_norm",
+    "gradient_variance",
+]

@@ -1,0 +1,105 @@
+"""Collectives over a named mesh axis (ICI data plane).
+
+Equivalents of the reference's collective ops (reference:
+srcs/python/kungfu/tensorflow/ops/collective.py, srcs/cpp/src/tensorflow/
+ops/cpu/collective.cpp), restated for SPMD JAX: every function takes a
+pytree and an `axis_name` and must be called inside `shard_map`/`pmap`
+tracing over that axis. XLA lowers psum/all_gather/ppermute directly onto
+ICI rings — topology selection (the reference's 7 strategy graphs) is the
+compiler's job here, not ours.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce(tree, axis_name: str = "data"):
+    """Sum each leaf over the mesh axis (reference KungfuAllReduce, sum)."""
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def all_reduce_mean(tree, axis_name: str = "data"):
+    """Mean each leaf over the mesh axis — the S-SGD gradient op."""
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def group_all_reduce(tensors: Sequence, axis_name: str = "data") -> List:
+    """All-reduce a list of tensors. One psum per tensor, like the
+    reference's per-gradient ops; XLA fuses small ones automatically, so
+    explicit fusion is an optimization choice, not a correctness one."""
+    return [lax.psum(t, axis_name) for t in tensors]
+
+
+def broadcast(tree, axis_name: str = "data", root: int = 0):
+    """Every shard adopts `root`'s value (reference KungfuBroadcast).
+
+    Implemented as mask-then-psum: zero out non-root shards and sum. XLA
+    recognises the pattern; cost equals an all-reduce of the tree.
+    """
+
+    def bc(x):
+        idx = lax.axis_index(axis_name)
+        mask = (idx == root).astype(x.dtype)
+        return lax.psum(x * mask, axis_name)
+
+    return jax.tree_util.tree_map(bc, tree)
+
+
+def all_gather(x, axis_name: str = "data", axis: int = 0):
+    """Concatenate shards along the existing leading axis (reference
+    KungfuAllGather semantics: output leading dim = input dim x cluster
+    size)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def ring_neighbor(x, axis_name: str = "data", shift: int = 1):
+    """Receive the value held by rank (i - shift) mod n — a ring rotation
+    via collective_permute. The building block for gossip averaging."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def neighbor_exchange(tree, axis_name: str = "data", shift: int = 1):
+    """Rotate a whole pytree around the ring by `shift`."""
+    return jax.tree_util.tree_map(
+        lambda x: ring_neighbor(x, axis_name, shift), tree
+    )
+
+
+# -- fuse/defuse -------------------------------------------------------------
+# The reference packs a model into one flat buffer for fused all-reduce and
+# P2P model exchange (reference: srcs/python/kungfu/tensorflow/ops/
+# __init__.py:22-39, model_buffer.hpp). Same trick here: one contiguous
+# vector minimizes DCN round trips for pair-averaging model transfer.
+
+
+def fuse(tree) -> jnp.ndarray:
+    """Flatten a pytree into one 1-D f32-preserving buffer."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((0,), dtype=jnp.float32)
+    return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+
+def subtree_shapes(tree) -> List[Tuple]:
+    return [l.shape for l in jax.tree_util.tree_leaves(tree)]
+
+
+def defuse(buf: jnp.ndarray, tree_like):
+    """Unflatten `buf` back into the structure/shapes/dtypes of
+    `tree_like`."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree_like)
+    out = []
+    offset = 0
+    for l in leaves:
+        n = l.size
+        out.append(jnp.reshape(buf[offset:offset + n], l.shape).astype(
+            l.dtype))
+        offset += n
+    return jax.tree_util.tree_unflatten(treedef, out)

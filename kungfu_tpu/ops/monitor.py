@@ -1,0 +1,130 @@
+"""Training-health monitors: gradient noise scale and gradient variance.
+
+Pure-JAX restatements of the reference's monitoring ops (reference:
+srcs/python/kungfu/tensorflow/ops/monitor.py:4-16 for the GNS estimator,
+srcs/cpp/src/tensorflow/ops/cpu/collective.cpp NoiseScale kernel for the
+EMA smoothing, and optimizers/grad_variance.py for the variance monitor).
+The stateful C++ EMA kernel becomes an explicit JAX state dataclass so it
+lives inside the jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class GradNoiseScaleState(NamedTuple):
+    """EMA state of the biased G/S estimators (bias-corrected like the
+    reference's ExponentialMovingAverage, ema.hpp)."""
+
+    g_ema: jnp.ndarray  # EMA of |G|^2 estimate
+    s_ema: jnp.ndarray  # EMA of tr(Sigma) estimate
+    count: jnp.ndarray  # update count for bias correction
+
+
+def init_noise_scale() -> GradNoiseScaleState:
+    z = jnp.zeros((), dtype=jnp.float32)
+    return GradNoiseScaleState(g_ema=z, s_ema=z, count=z)
+
+
+def _ema_update(ema, x, count, alpha):
+    new = (1 - alpha) * ema + alpha * x
+    corrected = new / (1 - (1 - alpha) ** (count + 1))
+    return new, corrected
+
+
+def update_noise_scale(
+    state: GradNoiseScaleState,
+    batch_small: float,
+    batch_big: float,
+    grad_local_fused: jnp.ndarray,
+    grad_avg_fused: jnp.ndarray,
+    alpha: float = 0.6,
+    axis_name: str | None = None,
+):
+    """One GNS estimate from the (local grad, cluster-averaged grad) pair.
+
+    `batch_small` is the device batch, `batch_big` the global batch; the
+    pair of gradient norms gives unbiased estimators of |G|^2 and tr(Sigma)
+    (GNS paper, "An Empirical Model of Large-Batch Training"), matching
+    monitor.py:4-16 in the reference. With `axis_name`, the small-batch
+    norm is averaged over the mesh axis so every worker tracks the same
+    global estimate (the reference's per-worker estimates use one local
+    norm sample each and therefore differ across workers).
+    Returns (new_state, noise_scale).
+    """
+    return update_noise_scale_from_sq(
+        state,
+        batch_small,
+        batch_big,
+        g_sq_small=jnp.sum(jnp.square(grad_local_fused)),
+        g_sq_big=jnp.sum(jnp.square(grad_avg_fused)),
+        alpha=alpha,
+        axis_name=axis_name,
+    )
+
+
+def tree_sq_norm(tree) -> jnp.ndarray:
+    """Sum of squared entries across a pytree without materializing a fused
+    copy (cheaper than fuse() + norm on the train-step hot path)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    total = jnp.zeros((), dtype=jnp.float32)
+    for l in leaves:
+        flat = jnp.ravel(l).astype(jnp.float32)
+        total = total + jnp.vdot(flat, flat)
+    return total
+
+
+def update_noise_scale_from_sq(
+    state: GradNoiseScaleState,
+    batch_small: float,
+    batch_big: float,
+    g_sq_small: jnp.ndarray,
+    g_sq_big: jnp.ndarray,
+    alpha: float = 0.6,
+    axis_name: str | None = None,
+):
+    """GNS update from precomputed squared gradient norms."""
+    b_small = jnp.asarray(batch_small, dtype=jnp.float32)
+    b_big = jnp.asarray(batch_big, dtype=jnp.float32)
+    if axis_name is not None:
+        g_sq_small = lax.pmean(g_sq_small, axis_name)
+    # a 1-worker cluster (local run, or elastic shrink to one) has
+    # batch_big == batch_small: the estimator is undefined, so freeze the
+    # EMAs instead of poisoning them with NaN
+    denom_ok = b_big > b_small
+    safe = jnp.where(denom_ok, b_big - b_small, 1.0)
+    g_biased = (b_big * g_sq_big - b_small * g_sq_small) / safe
+    s_biased = (g_sq_small - g_sq_big) * b_small * b_big / safe
+
+    g_new, g_corr = _ema_update(state.g_ema, g_biased, state.count, alpha)
+    s_new, s_corr = _ema_update(state.s_ema, s_biased, state.count, alpha)
+    noise_scale = s_corr / jnp.where(g_corr == 0, 1e-30, g_corr)
+    new_state = GradNoiseScaleState(
+        g_ema=g_new, s_ema=s_new, count=state.count + 1
+    )
+    new_state = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(denom_ok, new, old), new_state, state
+    )
+    return new_state, jnp.where(denom_ok, noise_scale, 0.0)
+
+
+def gradient_variance(grads, axis_name: str = "data") -> jnp.ndarray:
+    """Summed per-tensor gradient variance across workers.
+
+    For each tensor: Var = mean(g^2) - mean(g)^2 over the axis; the monitor
+    value is sum_t ||Var_t|| (reference: grad_variance.py:45-60). Call
+    inside shard_map with the per-worker gradients.
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    total = jnp.zeros((), dtype=jnp.float32)
+    for g in leaves:
+        g32 = g.astype(jnp.float32)
+        mean_sq = lax.pmean(jnp.square(g32), axis_name)
+        sq_mean = jnp.square(lax.pmean(g32, axis_name))
+        total = total + jnp.linalg.norm(jnp.ravel(mean_sq - sq_mean))
+    return total

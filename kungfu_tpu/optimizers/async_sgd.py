@@ -1,0 +1,80 @@
+"""Pair averaging (AD-PSGD) — ICI-native synchronous-gossip form.
+
+The reference's PairAveragingOptimizer pulls a random peer's model over
+TCP, averages 0.5/0.5, applies local gradients, and publishes its model
+(reference: srcs/python/kungfu/tensorflow/optimizers/async_sgd.py:78-142).
+XLA has no one-sided async P2P inside a compiled step, so the framework
+offers the algorithm in two forms (SURVEY §7 "hard parts"):
+
+1. **This module** — gossip over ICI: each step, workers pair up around the
+   ring with a rotating stride and average weights 0.5/0.5 via
+   `collective_permute`. Deterministic pairing replaces random peer choice
+   (ppermute's permutation must be static), cycling through all strides so
+   information mixes like AD-PSGD's random walk. Everything stays inside
+   the jitted step at ICI bandwidth.
+
+2. `kungfu_tpu.parallel.pair_host` — the faithful asynchronous DCN form:
+   random peer, model pulled via the libkf P2P store with double-buffered
+   prefetch, matching the reference's AsyncRequestModel design.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from ..ops.collective import ring_neighbor
+
+
+class PairAveragingState(NamedTuple):
+    step: jnp.ndarray
+    inner: optax.OptState
+
+
+def pair_averaging(
+    inner: optax.GradientTransformation,
+    axis_name: str = "data",
+    blend: float = 0.5,
+) -> optax.GradientTransformation:
+    def init(params):
+        return PairAveragingState(
+            step=jnp.zeros((), dtype=jnp.int32), inner=inner.init(params)
+        )
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("pair_averaging() requires params")
+        n = lax.axis_size(axis_name)
+        updates, new_inner = inner.update(grads, state.inner, params)
+        if n > 1:
+            # Hypercube gossip: cycle through power-of-two strides
+            # {1, 2, 4, ..., <n}. ppermute permutations must be static, so
+            # lax.switch selects among the precompiled strides — O(log n)
+            # branches (cycling all n-1 strides would compile O(n) copies
+            # of the whole-model rotation). Power-of-two pairings mix any
+            # initial spread in one sweep of log2(n) steps, which
+            # dominates uniform-random pairing in mixing rate.
+            strides = []
+            s = 1
+            while s < n:
+                strides.append(s)
+                s *= 2
+            branches = [
+                (lambda t, s=s: jax.tree_util.tree_map(
+                    lambda x: ring_neighbor(x, axis_name, s), t))
+                for s in strides
+            ]
+            idx = state.step % len(branches)
+            peer_params = lax.switch(idx, branches, params)
+            updates = jax.tree_util.tree_map(
+                lambda u, p, q: u + blend * (q - p), updates, params,
+                peer_params,
+            )
+        return updates, PairAveragingState(step=state.step + 1,
+                                           inner=new_inner)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,114 @@
+"""S-SGD with online training-health monitors in optimizer state.
+
+Rebuilds of MonitorGradientNoiseScaleOptimizer and
+MonitorGradientVarianceOptimizer (reference: srcs/python/kungfu/tensorflow/
+optimizers/{grad_noise_scale,grad_variance}.py). Where the reference
+prints via tf.print, these keep the latest statistic in optimizer state so
+the training loop (or an adaptation policy) reads it directly — the
+statistic is what drives adaptive batch-size/cluster-size decisions.
+
+Both monitors piggyback on the S-SGD all-reduce: GNS costs no extra
+collective (it reuses local + averaged gradients); variance costs one
+extra psum of squared gradients.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from ..ops.collective import all_reduce_mean
+from ..ops.monitor import (
+    GradNoiseScaleState,
+    gradient_variance,
+    init_noise_scale,
+    tree_sq_norm,
+    update_noise_scale_from_sq,
+)
+
+
+class GNSMonitorState(NamedTuple):
+    step: jnp.ndarray
+    gns: GradNoiseScaleState
+    noise_scale: jnp.ndarray  # latest (EMA-smoothed) estimate
+    inner: optax.OptState
+
+
+def monitor_gradient_noise_scale(
+    inner: optax.GradientTransformation,
+    device_batch_size: int,
+    axis_name: str = "data",
+    alpha: float = 0.6,
+    interval: int = 1,
+) -> optax.GradientTransformation:
+    """S-SGD whose state tracks the gradient noise scale B_noise."""
+
+    def init(params):
+        return GNSMonitorState(
+            step=jnp.zeros((), dtype=jnp.int32),
+            gns=init_noise_scale(),
+            noise_scale=jnp.zeros((), dtype=jnp.float32),
+            inner=inner.init(params),
+        )
+
+    def update(grads, state, params=None):
+        n = lax.axis_size(axis_name)
+        avg_grads = all_reduce_mean(grads, axis_name)
+        new_gns, estimate = update_noise_scale_from_sq(
+            state.gns,
+            batch_small=device_batch_size,
+            batch_big=device_batch_size * n,
+            g_sq_small=tree_sq_norm(grads),
+            g_sq_big=tree_sq_norm(avg_grads),
+            alpha=alpha,
+            axis_name=axis_name,
+        )
+        tick = (state.step % interval) == 0
+        gns_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(tick, new, old), new_gns, state.gns
+        )
+        noise = jnp.where(tick, estimate, state.noise_scale)
+        updates, new_inner = inner.update(avg_grads, state.inner, params)
+        return updates, GNSMonitorState(
+            step=state.step + 1, gns=gns_state, noise_scale=noise,
+            inner=new_inner,
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+class VarianceMonitorState(NamedTuple):
+    step: jnp.ndarray
+    variance: jnp.ndarray  # latest summed gradient variance
+    inner: optax.OptState
+
+
+def monitor_gradient_variance(
+    inner: optax.GradientTransformation,
+    axis_name: str = "data",
+    interval: int = 1,
+) -> optax.GradientTransformation:
+    """S-SGD whose state tracks summed cross-worker gradient variance."""
+
+    def init(params):
+        return VarianceMonitorState(
+            step=jnp.zeros((), dtype=jnp.int32),
+            variance=jnp.zeros((), dtype=jnp.float32),
+            inner=inner.init(params),
+        )
+
+    def update(grads, state, params=None):
+        avg_grads = all_reduce_mean(grads, axis_name)
+        var = gradient_variance(grads, axis_name)
+        tick = (state.step % interval) == 0
+        variance = jnp.where(tick, var, state.variance)
+        updates, new_inner = inner.update(avg_grads, state.inner, params)
+        return updates, VarianceMonitorState(
+            step=state.step + 1, variance=variance, inner=new_inner
+        )
+
+    return optax.GradientTransformation(init, update)

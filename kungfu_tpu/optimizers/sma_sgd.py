@@ -1,0 +1,44 @@
+"""Synchronous model averaging (SMA / EA-SGD).
+
+Every step, each worker blends its weights toward the cluster-average model
+with factor alpha while still applying its *local* gradients (reference:
+srcs/python/kungfu/tensorflow/optimizers/sma_sgd.py:45-74; SMA paper
+"CrossBow", EA-SGD NIPS'15). The weight averaging decouples convergence
+from global batch size — the property that keeps accuracy at large
+cluster sizes where plain S-SGD degrades (reference README.md:188-193).
+
+In update-delta form (optax semantics):
+
+    delta = inner_update(local_grads) + alpha * (mean(params) - params)
+
+which equals the reference's assign-then-apply sequence exactly, since the
+gradients were computed at the pre-blend parameters there too.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+from ..ops.collective import all_reduce_mean
+
+
+def sma(
+    inner: optax.GradientTransformation,
+    alpha: float = 0.1,
+    axis_name: str = "data",
+) -> optax.GradientTransformation:
+    def init(params):
+        return inner.init(params)
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("sma() requires params to average")
+        avg_params = all_reduce_mean(params, axis_name)
+        updates, new_state = inner.update(grads, state, params)
+        updates = jax.tree_util.tree_map(
+            lambda u, p, a: u + alpha * (a - p), updates, params, avg_params
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)

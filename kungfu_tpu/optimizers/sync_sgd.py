@@ -1,0 +1,35 @@
+"""Synchronous SGD: gradient all-reduce before the inner update.
+
+The Horovod-equivalent S-SGD data-parallel optimizer (reference:
+srcs/python/kungfu/tensorflow/optimizers/sync_sgd.py:48-79). On TPU the
+per-gradient all-reduce graph machinery reduces to a single `pmean` per
+leaf, which XLA fuses and schedules onto ICI; no fuse/defuse or NCCL order
+negotiation is needed (SURVEY §5.8, §7).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from ..ops.collective import all_reduce_mean
+
+
+def sync_sgd(
+    inner: optax.GradientTransformation, axis_name: str = "data"
+) -> optax.GradientTransformation:
+    """Wrap `inner` so gradients are cluster-averaged before it runs.
+
+    Use inside a shard_map'd train step:
+
+        tx = sync_sgd(optax.sgd(0.1))
+        updates, opt_state = tx.update(grads, opt_state, params)
+    """
+
+    def init(params):
+        return inner.init(params)
+
+    def update(grads, state, params=None):
+        grads = all_reduce_mean(grads, axis_name)
+        return inner.update(grads, state, params)
+
+    return optax.GradientTransformation(init, update)

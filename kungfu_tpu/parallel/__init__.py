@@ -1,0 +1,36 @@
+"""SPMD data-parallel runtime on a jax.sharding.Mesh.
+
+This package is where the reference's "distributed optimizer wrapper + TF
+session" pattern becomes TPU-native (SURVEY §7): a `Mesh` over the chips,
+worker-local training state laid out with a leading mesh-axis dimension
+(row i = worker i's model), and a jitted `shard_map` train step whose
+collectives compile onto ICI. Elastic resize swaps the mesh at an epoch
+boundary and re-broadcasts state (kungfu_tpu.elastic).
+"""
+
+from .mesh import (
+    axis_size,
+    broadcast_params,
+    data_mesh,
+    init_worker_state,
+    replicate_to_workers,
+    shard_batch,
+    unstack_worker_state,
+    worker_sharding,
+)
+from .pair_host import PairAveragingHost
+from .train import build_eval_step, build_train_step
+
+__all__ = [
+    "data_mesh",
+    "axis_size",
+    "replicate_to_workers",
+    "unstack_worker_state",
+    "init_worker_state",
+    "broadcast_params",
+    "shard_batch",
+    "worker_sharding",
+    "build_train_step",
+    "build_eval_step",
+    "PairAveragingHost",
+]

@@ -1,0 +1,122 @@
+"""Mesh construction and worker-state layout.
+
+Layout convention: **worker-local state is stacked along a leading mesh-axis
+dimension** — a pytree whose leaves have shape (n_workers, ...), sharded
+P(axis) so each chip holds exactly its own row. This one representation
+serves every parallelism mode:
+
+- sync SGD keeps all rows bit-identical (asserted in tests),
+- SMA / pair-averaging rows diverge by design,
+- elastic resize reshapes the leading axis at the epoch boundary,
+- broadcast/init is a row-0 copy.
+
+Per-chip memory equals the replicated layout (each chip stores one model),
+so nothing is paid for the generality.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def data_mesh(
+    num_devices: Optional[int] = None, axis_name: str = "data"
+) -> Mesh:
+    """A 1-D mesh over the first `num_devices` visible devices.
+
+    On a TPU pod slice, call after `jax.distributed.initialize()` (kfrun
+    does this) so `jax.devices()` spans all hosts.
+    """
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def axis_size(mesh: Mesh, axis_name: str = "data") -> int:
+    return mesh.shape[axis_name]
+
+
+def worker_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
+    """Sharding of worker-stacked state: leading dim split over the axis."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicate_to_workers(tree, mesh: Mesh, axis_name: str = "data"):
+    """Tile a single model to (n, ...) rows and shard rows onto chips.
+
+    The data-plane equivalent of the reference's BroadcastGlobalVariablesOp
+    at init (reference: srcs/python/kungfu/tensorflow/initializer/): every
+    worker starts from the same row-0 state.
+    """
+    n = axis_size(mesh, axis_name)
+    sharding = worker_sharding(mesh, axis_name)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            jnp.broadcast_to(jnp.asarray(x)[None], (n,) + jnp.shape(x)),
+            sharding,
+        ),
+        tree,
+    )
+
+
+def unstack_worker_state(tree, row: int = 0):
+    """Extract one worker's row as an unstacked pytree (for eval/export)."""
+    return jax.tree_util.tree_map(lambda x: x[row], tree)
+
+
+def init_worker_state(tx, stacked_params, mesh: Mesh,
+                      axis_name: str = "data"):
+    """Build per-worker optimizer state for worker-stacked params."""
+
+    def dev_init(params_s):
+        local = jax.tree_util.tree_map(lambda x: x[0], params_s)
+        state = tx.init(local)
+        return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], state)
+
+    f = shard_map(
+        dev_init,
+        mesh=mesh,
+        in_specs=(P(axis_name),),
+        out_specs=P(axis_name),
+        check_vma=False,
+    )
+    return jax.jit(f)(stacked_params)
+
+
+def broadcast_params(stacked, mesh: Mesh, root: int = 0,
+                     axis_name: str = "data"):
+    """Reset every worker's row to worker `root`'s row — the resync op used
+    at elastic boundaries and AdaSGD switches."""
+
+    from ..ops.collective import broadcast as bc_op
+
+    @partial(jax.jit)
+    def run(tree):
+        return shard_map(
+            lambda t: bc_op(t, axis_name, root),
+            mesh=mesh,
+            in_specs=(P(axis_name),),
+            out_specs=P(axis_name),
+            check_vma=False,
+        )(tree)
+
+    return run(stacked)
+
+
+def shard_batch(batch, mesh: Mesh, axis_name: str = "data"):
+    """Place a global batch so its leading dim splits across workers."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, worker_sharding(mesh, axis_name)), batch
+    )

@@ -1,0 +1,123 @@
+"""Asynchronous pair averaging over DCN — the faithful AD-PSGD form.
+
+This is the cross-host counterpart of
+`kungfu_tpu.optimizers.pair_averaging` (ICI gossip): each step the worker
+
+1. picks a random peer,
+2. pulls that peer's fused model from its libkf store — on a *background
+   prefetch thread*, double-buffered, so the DCN transfer overlaps the
+   previous compute step (mirroring the reference's AsyncRequestModel
+   design, srcs/cpp/src/tensorflow/ops/cpu/peer_to_peer.cpp:166-255),
+3. blends 0.5/0.5 with the local model,
+4. publishes its own fused model for others.
+
+Asynchrony means no barrier anywhere: a slow worker never blocks the
+cluster, which is the property that decouples convergence from stragglers
+(reference async-scalability claim, README.md:207-209).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.collective import defuse, fuse
+from ..peer import Peer
+
+
+class PairAveragingHost:
+    def __init__(
+        self,
+        peer: Peer,
+        name: str = "pair_avg_model",
+        blend: float = 0.5,
+        seed: Optional[int] = None,
+    ):
+        self._peer = peer
+        self._name = name
+        self._blend = blend
+        self._rng = random.Random(seed)
+        self._prefetch: Optional[threading.Thread] = None
+        self._fetched: Optional[np.ndarray] = None
+        self._template: Optional[np.ndarray] = None
+        self._stopped = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init_store(self, params):
+        """Publish the initial model and barrier, like the reference's
+        init_store (async_sgd.py:106-108)."""
+        fused = np.asarray(fuse(params))
+        self._template = np.zeros_like(fused)
+        self._peer.save(self._name, fused)
+        self._peer.barrier()
+        self._start_prefetch()
+
+    def _random_peer(self) -> int:
+        # uniform over the n-1 other peers (draw from n-1 slots and skip
+        # self; remapping a self-draw to a fixed neighbor would bias it)
+        n, r = self._peer.size, self._peer.rank
+        t = self._rng.randrange(n - 1)
+        return t if t < r else t + 1
+
+    def stop(self):
+        """Join the in-flight prefetch. MUST be called before closing the
+        peer — a native request running while the peer is freed is a
+        use-after-free."""
+        self._stopped = True
+        if self._prefetch is not None:
+            self._prefetch.join()
+            self._prefetch = None
+
+    def _start_prefetch(self):
+        if self._peer.size <= 1 or self._stopped:
+            return
+
+        target = self._random_peer()
+
+        def fetch():
+            try:
+                self._fetched = self._peer.request(
+                    target, self._name, like=self._template
+                )
+            except Exception:
+                self._fetched = None  # peer busy/missing: skip this round
+
+        self._prefetch = threading.Thread(target=fetch, daemon=True)
+        self._prefetch.start()
+
+    # -- per-step -----------------------------------------------------------
+
+    def mix(self, params):
+        """Blend local params with the prefetched peer model, publish the
+        result, and start the next prefetch. Call once per step, outside
+        the jitted grad/update step."""
+        if self._template is None:
+            self.init_store(params)
+            return params
+        if self._prefetch is not None:
+            self._prefetch.join()
+        other = self._fetched
+        if other is not None:
+            fused = fuse(params)
+            mixed = (1 - self._blend) * fused + self._blend * jnp.asarray(
+                other
+            )
+            params = defuse(mixed, params)
+            self._peer.save(self._name, np.asarray(mixed))
+        else:
+            self._peer.save(self._name, np.asarray(fuse(params)))
+        self._start_prefetch()
+        return params
+
+    def publish(self, params):
+        """Publish without mixing (e.g. after pure-local warmup steps)."""
+        fused = np.asarray(fuse(params))
+        if self._template is None:
+            self._template = np.zeros_like(fused)
+        self._peer.save(self._name, fused)

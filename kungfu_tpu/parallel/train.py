@@ -1,0 +1,79 @@
+"""The SPMD train step: one jitted function for every optimizer family.
+
+Replaces the reference's TF-optimizer wrapper + session machinery
+(reference: srcs/python/kungfu/tensorflow/optimizers/core.py) with a single
+`shard_map`-compiled step over the mesh: forward + backward on the local
+batch shard, distributed optax update (whose collectives ride ICI), and
+in-place parameter application. Worker-local state uses the stacked layout
+of kungfu_tpu.parallel.mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import optax
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def build_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = "data",
+    donate: bool = True,
+):
+    """Compile a train step for worker-stacked (params, opt_state).
+
+    `loss_fn(params, batch) -> scalar` sees one worker's (unstacked) params
+    and its local batch shard. Returns
+    `step(params, opt_state, batch) -> (params, opt_state, mean_loss)`.
+    """
+    squeeze = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+    unsqueeze = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+
+    def device_step(params_s, opt_s, batch):
+        params = squeeze(params_s)
+        opt_state = squeeze(opt_s)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (
+            unsqueeze(params),
+            unsqueeze(opt_state),
+            lax.pmean(loss, axis_name),
+        )
+
+    mapped = shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name), P()),
+        check_vma=False,
+    )
+    donate_argnums: Tuple[int, ...] = (0, 1) if donate else ()
+    return jax.jit(mapped, donate_argnums=donate_argnums)
+
+
+def build_eval_step(
+    metric_fn: Callable, mesh: Mesh, axis_name: str = "data"
+):
+    """Compile an eval step: mean of `metric_fn(params, batch)` over the
+    mesh, using worker 0's convention that all rows are equivalent for
+    sync training (for diverged averaging runs, evaluate a chosen row)."""
+
+    def device_eval(params_s, batch):
+        params = jax.tree_util.tree_map(lambda x: x[0], params_s)
+        return lax.pmean(metric_fn(params, batch), axis_name)
+
+    mapped = shard_map(
+        device_eval,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

@@ -4,15 +4,22 @@ All JAX tests run on a virtual 8-device CPU mesh
 (``--xla_force_host_platform_device_count=8``) so multi-chip sharding logic
 is exercised without TPU hardware, mirroring the reference's single-machine
 multi-process emulation strategy (reference: scripts/tests/*).
-These env vars must be set before jax is imported anywhere.
+
+This environment registers the axon TPU PJRT plugin via sitecustomize and
+it wins over the JAX_PLATFORMS env var, so the CPU backend must be forced
+through jax.config before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("KF_LOG_LEVEL", "warn")
+
+import jax  # noqa: E402  (must follow the env setup above)
+
+jax.config.update("jax_platforms", "cpu")
