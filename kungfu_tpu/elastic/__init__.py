@@ -1,0 +1,18 @@
+"""Elastic training runtime: config server, schedules, training hooks.
+
+The pieces that let the cluster grow/shrink *during* training (reference
+pillar 3, README.md): a versioned-cluster HTTP config server, the
+step->size schedule parser, and the ElasticCallback that drives
+propose/resize/state-resync from inside a training loop.
+"""
+
+from .config_server import ConfigServer
+from .hooks import ElasticCallback, ElasticState
+from .schedule import step_based_schedule
+
+__all__ = [
+    "ConfigServer",
+    "step_based_schedule",
+    "ElasticCallback",
+    "ElasticState",
+]

@@ -1,0 +1,181 @@
+"""Versioned-cluster config server.
+
+HTTP source of truth for elastic membership (reference implementation:
+tests/go/cmd/kungfu-config-server-example/kungfu-config-server-example.go):
+
+- GET  /get           -> current Stage JSON (404 until seeded)
+- PUT  /put           -> propose a full Stage (validated; version must grow)
+- POST /addworker     -> grow by one worker (version++)
+- POST /removeworker  -> shrink by one worker (version++)
+- POST /clear         -> remove all workers (version++)
+- POST /reset         -> restore the initial seeded stage (version++)
+- GET  /stop          -> shut the server down
+
+Run standalone: `python -m kungfu_tpu.elastic.config_server --port 9100`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..peer import Stage
+from ..plan import Cluster
+
+
+class ConfigServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9100):
+        self.host = host
+        self.port = port
+        self._lock = threading.Lock()
+        self._stage: Optional[Stage] = None
+        self._initial: Optional[Stage] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- state transitions (all under lock) ---------------------------------
+
+    def _put(self, stage: Stage) -> Optional[str]:
+        err = stage.cluster.validate()
+        if err:
+            return f"invalid cluster: {err}"
+        with self._lock:
+            if self._stage is not None and stage.version <= \
+                    self._stage.version:
+                return (f"stale version {stage.version} <= "
+                        f"{self._stage.version}")
+            if self._initial is None:
+                self._initial = stage
+            self._stage = stage
+        return None
+
+    def _resize(self, delta: int) -> Optional[str]:
+        with self._lock:
+            if self._stage is None:
+                return "no stage"
+            new_size = len(self._stage.cluster.workers) + delta
+            if new_size < 0:
+                return "cannot shrink below 0"
+            cluster = self._stage.cluster.resize(new_size)
+            self._stage = Stage(self._stage.version + 1, cluster)
+        return None
+
+    def _clear(self) -> Optional[str]:
+        with self._lock:
+            if self._stage is None:
+                return "no stage"
+            empty = Cluster(runners=self._stage.cluster.runners,
+                            workers=type(self._stage.cluster.workers)())
+            self._stage = Stage(self._stage.version + 1, empty)
+        return None
+
+    def _reset(self) -> Optional[str]:
+        with self._lock:
+            if self._initial is None:
+                return "never seeded"
+            self._stage = Stage(self._stage.version + 1,
+                                self._initial.cluster)
+        return None
+
+    def stage_json(self) -> Optional[str]:
+        with self._lock:
+            return None if self._stage is None else self._stage.to_json()
+
+    # -- http ---------------------------------------------------------------
+
+    def _handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, body: str = ""):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path.startswith("/get"):
+                    body = server.stage_json()
+                    if body is None:
+                        self._reply(404, '{"error": "no stage"}')
+                    else:
+                        self._reply(200, body)
+                elif self.path.startswith("/stop"):
+                    self._reply(200, "{}")
+                    threading.Thread(target=server.stop,
+                                     daemon=True).start()
+                else:
+                    self._reply(404, '{"error": "unknown path"}')
+
+            def _do_update(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode() if n else ""
+                err = None
+                if self.path.startswith("/put"):
+                    try:
+                        err = server._put(Stage.from_json(body))
+                    except (ValueError, KeyError) as e:
+                        err = f"bad stage json: {e}"
+                elif self.path.startswith("/addworker"):
+                    err = server._resize(+1)
+                elif self.path.startswith("/removeworker"):
+                    err = server._resize(-1)
+                elif self.path.startswith("/clear"):
+                    err = server._clear()
+                elif self.path.startswith("/reset"):
+                    err = server._reset()
+                else:
+                    err = "unknown path"
+                if err:
+                    self._reply(400, json.dumps({"error": err}))
+                else:
+                    self._reply(200, server.stage_json() or "{}")
+
+            do_PUT = _do_update
+            do_POST = _do_update
+
+        return Handler
+
+    def start(self) -> "ConfigServer":
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._handler())
+        self.port = self._httpd.server_port  # resolves port=0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    @property
+    def get_url(self) -> str:
+        return f"http://{self.host}:{self.port}/get"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9100)
+    args = ap.parse_args(argv)
+    server = ConfigServer(args.host, args.port).start()
+    print(f"[kf-config-server] serving on {server.get_url}", flush=True)
+    try:
+        server._thread.join()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Elastic training-loop hooks: schedule-driven resize + state resync.
+
+Rebuild of the reference's elastic hooks (reference: srcs/python/kungfu/
+tensorflow/hooks/elastic.py and experimental/hook/elastic.py): after every
+step the callback checks the schedule, proposes a new cluster size to the
+config server, polls for agreed membership changes, and — when the epoch
+switches — resyncs the training position (max step / trained samples over
+survivors) and re-broadcasts model state to joiners.
+
+On TPU an epoch switch is a recompile boundary: the JAX mesh is static, so
+the caller rebuilds mesh + jitted step after `after_step` reports a
+change (SURVEY §7 "elastic resize x static XLA meshes").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..ops.collective import pack_bytes, unpack_bytes
+from ..peer import Peer
+from .schedule import step_based_schedule
+
+
+@dataclass
+class ElasticState:
+    step: int = 0
+    trained_samples: int = 0
+    changed: bool = False
+    keep: bool = True
+
+
+class ElasticCallback:
+    """Drives propose -> consensus-resize -> resync from a training loop.
+
+    Usage:
+        elastic = ElasticCallback(peer, schedule="100:2,100:4")
+        while elastic.state.keep and elastic.state.step < max_steps:
+            params_s, opt_s, loss = train_step(...)
+            if elastic.after_step():       # True => epoch switched
+                params = elastic.resync_params(params)   # joiners synced
+                # rebuild mesh/jit for the new cluster size here
+    """
+
+    def __init__(
+        self,
+        peer: Peer,
+        schedule: str = "",
+        config_server: str = "",
+        samples_per_step: int = 0,
+    ):
+        self.peer = peer
+        self.schedule = schedule
+        self.config_server = config_server or peer.config.config_server
+        self.samples_per_step = samples_per_step
+        self.state = ElasticState()
+
+    def after_step(self) -> bool:
+        """Advance one step; returns True when cluster membership changed
+        (caller must then resync state and rebuild its mesh)."""
+        st = self.state
+        st.step += 1
+        st.trained_samples += self.samples_per_step * self.peer.size
+        if self.schedule:
+            want = step_based_schedule(self.schedule, st.step)
+            if want != self.peer.size and self.peer.rank == 0:
+                try:
+                    self.peer.propose_new_size(want, self.config_server)
+                except Exception as e:  # config server hiccup: retry later
+                    print(f"[kf-elastic] propose failed: {e}", flush=True)
+        changed, keep = self.peer.resize_from_url(self.config_server)
+        st.changed, st.keep = changed, keep
+        return changed
+
+    # -- state resync over the control plane --------------------------------
+
+    def sync_position(self) -> Tuple[int, int]:
+        """Agree on (step, trained_samples) = max over survivors
+        (reference: hooks/elastic.py:43-47, experimental elastic.py:25-37)."""
+        buf = np.array([self.state.step, self.state.trained_samples],
+                       dtype=np.int64)
+        agreed = self.peer.all_reduce(buf, op="max", name="kf::elastic::pos")
+        self.state.step = int(agreed[0])
+        self.state.trained_samples = int(agreed[1])
+        return self.state.step, self.state.trained_samples
+
+    def resync_params(self, params, root: int = 0):
+        """Broadcast a params pytree from `root` over DCN so joiners adopt
+        survivor state (the reference's BroadcastGlobalVariablesOp at the
+        epoch boundary). Byte-exact: dtypes (incl. ints/bools) survive."""
+        packed = pack_bytes(params)
+        synced = self.peer.broadcast(packed, root=root,
+                                     name="kf::elastic::model")
+        self.sync_position()
+        return unpack_bytes(synced, params)
+
+
+def shard_offset(
+    trained_samples: int, rank: int, size: int, batch: int
+) -> int:
+    """Dataset offset for a joining worker (the reference's elastic dataset
+    adaptor skips `trained_samples` then shards by rank;
+    reference: v1/datasets/adaptor.py:28-33)."""
+    return trained_samples + rank * batch

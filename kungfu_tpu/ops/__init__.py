@@ -16,8 +16,10 @@ from .collective import (
     broadcast,
     defuse,
     fuse,
+    pack_bytes,
     group_all_reduce,
     neighbor_exchange,
+    unpack_bytes,
     ring_neighbor,
     subtree_shapes,
 )
@@ -38,6 +40,8 @@ __all__ = [
     "all_gather",
     "fuse",
     "defuse",
+    "pack_bytes",
+    "unpack_bytes",
     "subtree_shapes",
     "ring_neighbor",
     "neighbor_exchange",

@@ -80,11 +80,50 @@ def neighbor_exchange(tree, axis_name: str = "data", shift: int = 1):
 
 
 def fuse(tree) -> jnp.ndarray:
-    """Flatten a pytree into one 1-D f32-preserving buffer."""
+    """Flatten a pytree into one 1-D buffer.
+
+    NOTE: mixed-dtype leaves promote to a common dtype (jnp.concatenate
+    semantics) and defuse() casts back — lossless for float hierarchies
+    (bf16/f16 under f32) but NOT for large ints/bools. For dtype-exact
+    host-side transfer (elastic resync, checkpoints) use
+    pack_bytes/unpack_bytes instead.
+    """
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:
         return jnp.zeros((0,), dtype=jnp.float32)
     return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+
+def pack_bytes(tree) -> "np.ndarray":
+    """Host-side dtype-exact packing: a pytree -> one uint8 numpy buffer."""
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return np.zeros((0,), dtype=np.uint8)
+    return np.concatenate(
+        [np.ascontiguousarray(np.asarray(l)).view(np.uint8).ravel()
+         for l in leaves]
+    )
+
+
+def unpack_bytes(buf, tree_like):
+    """Inverse of pack_bytes: uint8 numpy buffer -> pytree with the exact
+    shapes/dtypes of `tree_like`."""
+    import numpy as np
+
+    buf = np.asarray(buf, dtype=np.uint8)
+    leaves, treedef = jax.tree_util.tree_flatten(tree_like)
+    out = []
+    offset = 0
+    for l in leaves:
+        arr = np.asarray(l)
+        nbytes = arr.size * arr.itemsize
+        chunk = buf[offset:offset + nbytes]
+        out.append(
+            jnp.asarray(chunk.view(arr.dtype).reshape(arr.shape)))
+        offset += nbytes
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def subtree_shapes(tree) -> List[Tuple]:

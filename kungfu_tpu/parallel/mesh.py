@@ -16,7 +16,7 @@ so nothing is paid for the generality.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -95,24 +95,27 @@ def init_worker_state(tx, stacked_params, mesh: Mesh,
     return jax.jit(f)(stacked_params)
 
 
-def broadcast_params(stacked, mesh: Mesh, root: int = 0,
-                     axis_name: str = "data"):
-    """Reset every worker's row to worker `root`'s row — the resync op used
-    at elastic boundaries and AdaSGD switches."""
-
+@lru_cache(maxsize=32)
+def _broadcast_fn(mesh: Mesh, root: int, axis_name: str):
     from ..ops.collective import broadcast as bc_op
 
-    @partial(jax.jit)
-    def run(tree):
-        return shard_map(
+    return jax.jit(
+        shard_map(
             lambda t: bc_op(t, axis_name, root),
             mesh=mesh,
             in_specs=(P(axis_name),),
             out_specs=P(axis_name),
             check_vma=False,
-        )(tree)
+        )
+    )
 
-    return run(stacked)
+
+def broadcast_params(stacked, mesh: Mesh, root: int = 0,
+                     axis_name: str = "data"):
+    """Reset every worker's row to worker `root`'s row — the resync op used
+    at elastic boundaries and AdaSGD switches. The jitted broadcast is
+    cached per (mesh, root, axis) so repeat boundaries don't recompile."""
+    return _broadcast_fn(mesh, root, axis_name)(stacked)
 
 
 def shard_batch(batch, mesh: Mesh, axis_name: str = "data"):

@@ -199,15 +199,31 @@ class Peer:
             return False, True
         if self._native is None:
             return False, True
+        # Every member runs this consensus loop once per call — even when
+        # its own fetch shows no change. Skipping the round when the local
+        # fetch looks current would desynchronize against a peer that just
+        # fetched a *newer* stage (it would block in consensus forever
+        # while we run training collectives). The FIXED channel name keeps
+        # retry attempts FIFO-paired across peers even when they observe
+        # the config server at different moments (reference:
+        # peer.go:208-233 consensus-retry loop).
         while True:
-            stage = Stage.from_json(fetch_url(url))
-            if stage.version == self._version:
-                return False, True
-            # all current members must observe the same proposal before
-            # anyone switches — digest consensus over the control plane
-            if self.consensus(stage.digest(), name=f"resize:{stage.version}"):
+            try:
+                stage = Stage.from_json(fetch_url(url))
+            except Exception:
+                # transient config-server error: still take part in the
+                # consensus round (peers are gated on it), voting with the
+                # current membership so the round resolves as "no change"
+                # or "disagree -> retry" (the reference likewise tolerates
+                # fetch hiccups rather than dying)
+                stage = Stage(self._version,
+                              Cluster(runners=PeerList(),
+                                      workers=self._workers))
+            if self.consensus(stage.digest(), name="kf::resize"):
                 break
             time.sleep(0.05)
+        if stage.version == self._version:
+            return False, True
         return self._propose(stage)
 
     def _propose(self, stage: Stage) -> Tuple[bool, bool]:

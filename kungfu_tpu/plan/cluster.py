@@ -53,7 +53,11 @@ class Cluster:
             if w.ipv4 == target and port <= w.port:
                 port = w.port + 1
         if port == 0:
-            port = DEFAULT_PORT_RANGE.begin
+            # empty target host: stay inside the port range the job is
+            # actually using (visible from the other workers) rather than
+            # falling back to the default range
+            port = min((w.port for w in self.workers),
+                       default=DEFAULT_PORT_RANGE.begin)
         return Cluster(
             runners=self.runners,
             workers=PeerList([*self.workers, PeerID(target, port)]),

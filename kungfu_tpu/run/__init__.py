@@ -1,0 +1,18 @@
+"""kfrun — the launcher and elastic supervisor.
+
+The role of the reference's `kungfu-run` (reference: srcs/go/cmd/kungfu-run,
+srcs/go/kungfu/runner): spawn one worker process per slot with the KF_*
+env-var bootstrap, assign TPU chips to local slots, supervise the workers
+(fail-fast on crash), and — in watch mode — reconcile the local worker set
+whenever the cluster membership changes (config-server-driven elastic
+training).
+
+Usage:
+    python -m kungfu_tpu.run -np 4 -H 127.0.0.1:4 -- python3 train.py
+    python -m kungfu_tpu.run -np 4 -w -config-server http://...:9100/get -- ...
+"""
+
+from .job import ChipPool, Proc, spawn_worker
+from .watch import simple_run, watch_run
+
+__all__ = ["spawn_worker", "Proc", "ChipPool", "simple_run", "watch_run"]

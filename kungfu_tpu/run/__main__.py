@@ -1,0 +1,146 @@
+"""kfrun CLI: `python -m kungfu_tpu.run [flags] -- prog args...`
+
+Flag set mirrors the reference launcher (reference: srcs/go/kungfu/runner/
+flags.go:60-89): -np, -H, -self, -port-range, -strategy, -w (watch/elastic
+mode), -config-server, -logdir, -q, -keep, -timeout-ms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import urllib.error
+
+from ..peer import Stage, fetch_url, put_url
+from ..plan import (
+    DEFAULT_RUNNER_PORT,
+    Cluster,
+    HostList,
+    PeerID,
+    PortRange,
+)
+from .watch import simple_run, watch_run
+
+
+def infer_self_ipv4() -> str:
+    """Best-effort local IP discovery (reference: runner/discovery.go).
+    Single-host and loopback-cluster runs just use 127.0.0.1."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kfrun", description=__doc__)
+    ap.add_argument("-np", type=int, default=1, help="total workers")
+    ap.add_argument("-H", dest="hosts", default="",
+                    help="host list ip:slots[:pub],...")
+    ap.add_argument("-self", dest="self_ip", default="",
+                    help="this runner's IPv4")
+    ap.add_argument("-port-range", dest="port_range", default="10000-11000")
+    ap.add_argument("-strategy", default="AUTO")
+    ap.add_argument("-w", dest="watch", action="store_true",
+                    help="watch mode (elastic)")
+    ap.add_argument("-config-server", dest="config_server", default="",
+                    help="config server /get URL")
+    ap.add_argument("-runner-port", type=int, default=DEFAULT_RUNNER_PORT)
+    ap.add_argument("-logdir", default=".kfrun-logs")
+    ap.add_argument("-q", dest="quiet", action="store_true",
+                    help="don't mirror worker output to console")
+    ap.add_argument("-keep", action="store_true",
+                    help="watch mode: stay alive at 0 local workers")
+    ap.add_argument("prog", nargs=argparse.REMAINDER,
+                    help="-- program and args")
+    args = ap.parse_args(argv)
+
+    prog = args.prog
+    if prog and prog[0] == "--":
+        prog = prog[1:]
+    if not prog:
+        ap.error("no program given (use: kfrun [flags] -- prog args)")
+
+    hosts = HostList.parse(args.hosts) if args.hosts else None
+    if args.self_ip:
+        self_ip = args.self_ip
+    elif hosts is None:
+        self_ip = "127.0.0.1"
+    else:
+        # pick the host-list entry this machine matches: inferred NIC IP
+        # if listed, else loopback if listed, else (single-host list) that
+        # host — multi-host lists require -self to disambiguate
+        from ..plan import parse_ipv4
+
+        host_ips = {h.ipv4 for h in hosts}
+        inferred = infer_self_ipv4()
+        if parse_ipv4(inferred) in host_ips:
+            self_ip = inferred
+        elif parse_ipv4("127.0.0.1") in host_ips:
+            self_ip = "127.0.0.1"
+        elif len(hosts) == 1:
+            self_ip = hosts[0].public_addr
+        else:
+            print(
+                f"[kfrun] cannot tell which of {args.hosts} is this host "
+                f"(inferred {inferred}); pass -self",
+                file=sys.stderr,
+            )
+            return 2
+    if hosts is None:
+        hosts = HostList.single_host(args.np, self_ip)
+    port_range = PortRange.parse(args.port_range)
+    workers = hosts.gen_peer_list(args.np, port_range)
+    runners = hosts.gen_runner_list(args.runner_port)
+    cluster = Cluster(runners=runners, workers=workers)
+    err = cluster.validate()
+    if err:
+        print(f"[kfrun] invalid cluster: {err}", file=sys.stderr)
+        return 2
+    stage = Stage(version=0, cluster=cluster)
+    runner_id = PeerID.from_host(self_ip, args.runner_port)
+
+    if args.config_server:
+        # seed the config server if it has no stage yet, so workers'
+        # resize polls and external resize tools share one source of truth
+        try:
+            fetch_url(args.config_server)
+        except (urllib.error.URLError, urllib.error.HTTPError, OSError):
+            try:
+                put_url(args.config_server.replace("/get", "/put"),
+                        stage.to_json())
+            except Exception as e:
+                print(f"[kfrun] cannot seed config server: {e}",
+                      file=sys.stderr)
+
+    if args.watch:
+        slots = hosts.slots_of(runner_id.ipv4) or args.np
+        return watch_run(
+            prog,
+            runner_id,
+            slots=slots,
+            initial=stage,
+            strategy=args.strategy,
+            config_server=args.config_server,
+            logdir=args.logdir,
+            quiet=args.quiet,
+            keep=args.keep,
+        )
+    return simple_run(
+        prog,
+        runner_id.ipv4,
+        stage,
+        strategy=args.strategy,
+        config_server=args.config_server,
+        logdir=args.logdir,
+        quiet=args.quiet,
+        parent=runner_id,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""Worker process creation: env injection, chip assignment, log capture.
+
+TPU translation of the reference's job package (reference: srcs/go/job/
+{job,proc,gpu_resource,cuda_visible_device}.go): the GPU slot bitmask pool
+becomes a TPU chip pool driving TPU_VISIBLE_DEVICES (plus
+JAX_PLATFORMS=cpu passthrough for host-simulation runs), and each worker's
+stdout/stderr is captured to a log file and optionally tee'd to the
+console with a rank prefix (reference: srcs/go/utils/iostream).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .. import env as kfenv
+from ..plan import PeerID, PeerList
+
+
+class ChipPool:
+    """Bitmask allocator of local accelerator slots (reference GPUPool,
+    gpu_resource.go:17-51)."""
+
+    def __init__(self, slots: int):
+        self._free = list(range(slots))
+        self._lock = threading.Lock()
+
+    def get(self) -> Optional[int]:
+        with self._lock:
+            return self._free.pop(0) if self._free else None
+
+    def put(self, chip: int):
+        with self._lock:
+            self._free.append(chip)
+            self._free.sort()
+
+
+@dataclass
+class Proc:
+    """One supervised worker process."""
+
+    peer: PeerID
+    rank: int
+    popen: subprocess.Popen
+    chip: Optional[int]
+    log_path: str
+    pumps: List[threading.Thread] = field(default_factory=list)
+
+    def wait(self) -> int:
+        code = self.popen.wait()
+        for t in self.pumps:
+            t.join(timeout=2.0)
+        return code
+
+    def terminate(self):
+        if self.popen.poll() is None:
+            self.popen.terminate()
+
+    def kill(self):
+        if self.popen.poll() is None:
+            self.popen.kill()
+
+
+_COLORS = [31, 32, 33, 34, 35, 36, 91, 92, 93, 94, 95, 96]
+
+
+def _pump(stream, log_file, prefix: str, color: int, quiet: bool):
+    """Forward a worker stream to its log file (+ prefixed console)."""
+    with log_file:
+        for raw in iter(stream.readline, b""):
+            log_file.write(raw)
+            log_file.flush()
+            if not quiet:
+                line = raw.decode(errors="replace").rstrip("\n")
+                sys.stderr.write(
+                    f"\x1b[{color}m[{prefix}]\x1b[0m {line}\n")
+        stream.close()
+
+
+def spawn_worker(
+    prog: List[str],
+    self_id: PeerID,
+    peers: PeerList,
+    version: int,
+    strategy: str = "AUTO",
+    parent: Optional[PeerID] = None,
+    config_server: str = "",
+    chip: Optional[int] = None,
+    logdir: str = ".",
+    quiet: bool = False,
+    extra_env: Optional[Dict[str, str]] = None,
+) -> Proc:
+    rank = peers.rank(self_id)
+    env = dict(os.environ)
+    env.update(
+        kfenv.worker_env(
+            self_id,
+            peers,
+            version,
+            strategy=strategy,
+            parent=parent,
+            config_server=config_server,
+        )
+    )
+    if chip is not None:
+        # one TPU chip per slot, like CUDA_VISIBLE_DEVICES per GPU slot
+        # (reference: job.go:41-47); harmless when workers run on CPU
+        env["TPU_VISIBLE_DEVICES"] = str(chip)
+        env["TPU_PROCESS_BOUNDS"] = env.get("TPU_PROCESS_BOUNDS", "")
+    if extra_env:
+        env.update(extra_env)
+
+    os.makedirs(logdir, exist_ok=True)
+    log_path = os.path.join(logdir, f"worker-{rank}-{self_id.port}.log")
+    popen = subprocess.Popen(
+        prog,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        bufsize=0,
+    )
+    log_file = open(log_path, "wb")
+    color = _COLORS[(rank if rank is not None else 0) % len(_COLORS)]
+    pump = threading.Thread(
+        target=_pump,
+        args=(popen.stdout, log_file, str(rank), color, quiet),
+        daemon=True,
+    )
+    pump.start()
+    return Proc(
+        peer=self_id,
+        rank=rank if rank is not None else -1,
+        popen=popen,
+        chip=chip,
+        log_path=log_path,
+        pumps=[pump],
+    )

@@ -1,0 +1,238 @@
+"""Worker supervision: simple mode and the elastic watch loop.
+
+Rebuild of the reference's runner (reference: srcs/go/kungfu/runner/
+{watch,simple,handler}.go). The runner owns a libkf control endpoint on
+the runner port; workers (or the config server path through them) push
+"update" stages there, and the watch loop reconciles the local worker set:
+diff old/new membership, terminate departed workers, spawn joiners with a
+fresh epoch env. A worker crash (nonzero exit that wasn't an intentional
+removal) fails the whole runner fast, matching the reference's
+fail-fast-and-respawn-from-survivors model (SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from typing import Dict, List, Optional
+
+from ..ffi import NativePeer
+from ..peer import Stage
+from ..plan import PeerID, PeerList
+from .job import ChipPool, Proc, spawn_worker
+
+
+def _local_workers(workers: PeerList, host_ipv4: int) -> PeerList:
+    return workers.on_host(host_ipv4)
+
+
+def simple_run(
+    prog: List[str],
+    self_ipv4: int,
+    stage: Stage,
+    strategy: str = "AUTO",
+    config_server: str = "",
+    logdir: str = ".",
+    quiet: bool = False,
+    parent: Optional[PeerID] = None,
+) -> int:
+    """Non-elastic: spawn all local workers, wait, fail if any fails
+    (reference: runner/simple.go)."""
+    local = _local_workers(stage.cluster.workers, self_ipv4)
+    if not local:
+        print("[kfrun] no workers scheduled on this host", flush=True)
+        return 2
+    pool = ChipPool(len(local))
+    procs = [
+        spawn_worker(
+            prog,
+            w,
+            stage.cluster.workers,
+            stage.version,
+            strategy=strategy,
+            parent=parent,
+            config_server=config_server,
+            chip=pool.get(),
+            logdir=logdir,
+            quiet=quiet,
+        )
+        for w in local
+    ]
+    code = 0
+    for p in procs:
+        c = p.wait()
+        if c != 0:
+            print(f"[kfrun] worker rank {p.rank} exited with {c}",
+                  flush=True)
+            code = code or c
+    return code
+
+
+class Watcher:
+    """Elastic supervisor state machine."""
+
+    def __init__(
+        self,
+        prog: List[str],
+        runner_id: PeerID,
+        slots: int,
+        strategy: str,
+        config_server: str,
+        logdir: str,
+        quiet: bool,
+        keep: bool,
+    ):
+        self.prog = prog
+        self.runner_id = runner_id
+        self.strategy = strategy
+        self.config_server = config_server
+        self.logdir = logdir
+        self.quiet = quiet
+        self.keep = keep
+        self.pool = ChipPool(slots)
+        self.procs: Dict[PeerID, Proc] = {}
+        self.expected_exits: set = set()
+        self.stages: "queue.Queue[Optional[Stage]]" = queue.Queue()
+        self.seen_versions: set = set()
+        self.current_version = -1
+        self.control = NativePeer(str(runner_id), "", version=0)
+        self.control.set_control_handler(self._on_control)
+
+    # -- control channel ----------------------------------------------------
+
+    def _on_control(self, name: str, payload: bytes):
+        if name == "exit":
+            self.stages.put(None)
+            return
+        if name != "update":
+            return
+        try:
+            stage = Stage.from_json(payload.decode())
+        except Exception as e:  # malformed update must not kill the runner
+            print(f"[kfrun] bad update stage: {e}", flush=True)
+            return
+        # dedup: every worker notifies every runner (reference
+        # handler.go:86-105 dedups by version the same way)
+        if stage.version in self.seen_versions:
+            return
+        self.seen_versions.add(stage.version)
+        self.stages.put(stage)
+
+    # -- reconciliation -----------------------------------------------------
+
+    def _apply(self, stage: Stage):
+        if stage.version <= self.current_version:
+            return
+        self.current_version = stage.version
+        new_local = set(
+            _local_workers(stage.cluster.workers, self.runner_id.ipv4))
+        old_local = set(self.procs.keys())
+        for peer in old_local - new_local:
+            proc = self.procs.pop(peer)
+            proc.terminate()
+            try:
+                proc.popen.wait(timeout=5.0)
+            except Exception:
+                # wedged in a native collective or trapping SIGTERM:
+                # escalate rather than hanging the reconcile loop
+                proc.kill()
+                proc.popen.wait()
+            # reaped synchronously: do NOT leave a stale expected-exit
+            # marker behind — a future joiner may reuse this PeerID and a
+            # real crash of it must still fail fast
+            self.expected_exits.discard(peer)
+            if proc.chip is not None:
+                self.pool.put(proc.chip)
+        for peer in sorted(new_local - old_local):
+            self.procs[peer] = spawn_worker(
+                self.prog,
+                peer,
+                stage.cluster.workers,
+                stage.version,
+                strategy=self.strategy,
+                parent=self.runner_id,
+                config_server=self.config_server,
+                chip=self.pool.get(),
+                logdir=self.logdir,
+                quiet=self.quiet,
+            )
+        print(
+            f"[kfrun] epoch {stage.version}: {len(self.procs)} local "
+            f"worker(s) of {len(stage.cluster.workers)}",
+            flush=True,
+        )
+
+    def _check_procs(self) -> Optional[int]:
+        """Reap exits. Crash (unexpected nonzero) => fail fast."""
+        for peer, proc in list(self.procs.items()):
+            code = proc.popen.poll()
+            if code is None:
+                continue
+            del self.procs[peer]
+            if proc.chip is not None:
+                self.pool.put(proc.chip)
+            expected = peer in self.expected_exits
+            self.expected_exits.discard(peer)
+            if code != 0 and not expected:
+                print(
+                    f"[kfrun] worker rank {proc.rank} crashed with {code}; "
+                    "failing fast",
+                    flush=True,
+                )
+                return code
+        return None
+
+    def run(self, initial: Optional[Stage]) -> int:
+        self.control.start()
+        try:
+            if initial is not None:
+                self.stages.put(initial)
+            while True:
+                try:
+                    stage = self.stages.get(timeout=0.25)
+                    if stage is None:  # exit control message
+                        break
+                    self._apply(stage)
+                except queue.Empty:
+                    pass
+                code = self._check_procs()
+                if code is not None:
+                    self._shutdown()
+                    return code
+                if not self.procs and not self.keep \
+                        and self.current_version >= 0 \
+                        and self.stages.empty():
+                    break
+            self._shutdown()
+            return 0
+        finally:
+            self.control.close()
+
+    def _shutdown(self):
+        for proc in self.procs.values():
+            proc.terminate()
+        deadline = time.time() + 5.0
+        for proc in self.procs.values():
+            if proc.popen.poll() is None and time.time() < deadline:
+                try:
+                    proc.popen.wait(timeout=max(0.1,
+                                                deadline - time.time()))
+                except Exception:
+                    proc.kill()
+        self.procs.clear()
+
+
+def watch_run(
+    prog: List[str],
+    runner_id: PeerID,
+    slots: int,
+    initial: Optional[Stage],
+    strategy: str = "AUTO",
+    config_server: str = "",
+    logdir: str = ".",
+    quiet: bool = False,
+    keep: bool = False,
+) -> int:
+    w = Watcher(prog, runner_id, slots, strategy, config_server, logdir,
+                quiet, keep)
+    return w.run(initial)

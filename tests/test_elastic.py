@@ -1,0 +1,52 @@
+"""Elastic end-to-end: config server + watch runner + live resizes.
+
+The rebuild of the reference's run-elastic-test.sh (reference:
+scripts/tests/run-elastic-test.sh + kungfu-fake-adaptive-trainer): a
+config server holds the versioned cluster, kfrun -w supervises workers,
+and the fake adaptive trainer walks a resize schedule 2 -> 4 -> 1 while
+training position is agreed across epochs.
+"""
+
+import os
+import subprocess
+import sys
+
+from kungfu_tpu.elastic import ConfigServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKERS = os.path.join(REPO, "tests", "workers")
+
+
+def test_elastic_schedule_resize(tmp_path):
+    server = ConfigServer(port=0).start()
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["KF_TIMEOUT_MS"] = "60000"
+        env["KF_LOG_LEVEL"] = "warn"
+        env["PALLAS_AXON_POOL_IPS"] = ""  # control-plane-only workers
+        env["TEST_SCHEDULE"] = "2:2,2:4,4:1"
+        env["TEST_TOTAL_STEPS"] = "8"
+        cmd = [
+            sys.executable, "-m", "kungfu_tpu.run",
+            "-np", "2", "-H", "127.0.0.1:4",
+            "-port-range", "29000-29999",
+            "-w", "-config-server", server.get_url,
+            "-logdir", str(tmp_path), "-q",
+        ]
+        cmd += ["--", sys.executable,
+                os.path.join(WORKERS, "fake_adaptive_trainer.py")]
+        r = subprocess.run(cmd, cwd=REPO, env=env, timeout=180,
+                           capture_output=True, text=True)
+        logs = ""
+        for f in sorted(os.listdir(tmp_path)):
+            logs += f"--- {f} ---\n" + open(os.path.join(tmp_path, f)).read()
+        assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:], logs)
+        # grew to 4: at least one joiner synced position from survivors
+        assert "joined at epoch" in logs, logs
+        # shrank to 1: evicted workers exited cleanly
+        assert "evicted at step" in logs, logs
+        # the survivor finished the full schedule at size 1
+        assert "finished rank=0 size=1 step=8" in logs, logs
+    finally:
+        server.stop()

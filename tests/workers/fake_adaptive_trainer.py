@@ -1,0 +1,42 @@
+"""Fake adaptive trainer: replays the elastic-training protocol without ML
+(the reference's kungfu-fake-adaptive-trainer, tests/go/cmd/
+kungfu-fake-adaptive-trainer). Schedule-driven resizes via the config
+server; joiners resync the training position from survivors."""
+
+import os
+import sys
+
+import numpy as np
+
+import kungfu_tpu
+from kungfu_tpu.elastic import ElasticCallback
+
+TOTAL_STEPS = int(os.environ.get("TEST_TOTAL_STEPS", "8"))
+SCHEDULE = os.environ.get("TEST_SCHEDULE", "2:2,2:4,4:1")
+
+p = kungfu_tpu.init()
+elastic = ElasticCallback(p, schedule=SCHEDULE, samples_per_step=1)
+if p.config.version > 0:
+    # joiner: adopt the survivors' position before entering the loop
+    elastic.sync_position()
+    print(f"joined at epoch {p.config.version} step {elastic.state.step}",
+          flush=True)
+
+while elastic.state.step < TOTAL_STEPS:
+    out = p.all_reduce(
+        np.ones(16, dtype=np.float32),
+        name=f"work:{p.version}:{elastic.state.step}",
+    )
+    assert out[0] == p.size
+    if elastic.after_step():
+        if not elastic.state.keep:
+            print(f"evicted at step {elastic.state.step}", flush=True)
+            sys.exit(0)
+        elastic.sync_position()
+        print(
+            f"epoch {p.version}: size={p.size} step={elastic.state.step}",
+            flush=True,
+        )
+
+print(f"finished rank={p.rank} size={p.size} step={elastic.state.step} "
+      f"samples={elastic.state.trained_samples}", flush=True)

@@ -1,0 +1,23 @@
+"""Worker that exits nonzero after a few steps — the reference's
+kungfu-bad-worker fault-injection tool (tests/go/cmd/kungfu-bad-worker)."""
+
+import os
+import sys
+
+import numpy as np
+
+import kungfu_tpu
+
+p = kungfu_tpu.init()
+bad_rank = int(os.environ.get("TEST_BAD_RANK", "1"))
+for step in range(3):
+    p.all_reduce(np.ones(10, dtype=np.float32), name=f"g:{step}")
+if p.rank == bad_rank:
+    print(f"rank={p.rank} injecting failure", flush=True)
+    sys.exit(3)
+# others block on a collective the dead rank will never join; the runner's
+# fail-fast must reap us (bounded by KF_TIMEOUT_MS)
+try:
+    p.all_reduce(np.ones(10, dtype=np.float32), name="never")
+except Exception:
+    sys.exit(4)

@@ -1,0 +1,24 @@
+"""Fake trainer: simulates a training job's communication pattern with zero
+ML deps (the reference's fake-trainer testing philosophy, SURVEY §4).
+Launched by kfrun in the launcher integration tests."""
+
+import sys
+
+import numpy as np
+
+import kungfu_tpu
+
+p = kungfu_tpu.init()
+for step in range(5):
+    out = p.all_reduce(
+        np.full(1000, float(p.rank + 1), dtype=np.float32),
+        name=f"grad:{step}",
+    )
+    expect = p.size * (p.size + 1) / 2
+    if out[0] != expect:
+        print(f"rank={p.rank} step={step} BAD {out[0]} != {expect}",
+              flush=True)
+        sys.exit(1)
+p.barrier()
+print(f"rank={p.rank} size={p.size} local_rank={p.local_rank} ok",
+      flush=True)
