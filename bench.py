@@ -1,0 +1,106 @@
+"""Headline benchmark: ResNet-50 SyncSGD training throughput per chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Mirrors the reference's synthetic-benchmark methodology (reference:
+benchmarks/system/benchmark_kungfu.py: synthetic ImageNet-shaped data,
+Horovod-style timed iterations, images/sec). Runs the full distributed
+train step (forward + backward + gradient pmean + SGD-momentum update +
+BatchNorm-stat sync) through this framework's SPMD path on every visible
+chip and reports per-chip throughput.
+
+vs_baseline: ratio against 360 images/sec/chip — the widely reproduced
+ResNet-50 fp32 V100 figure of the Horovod-era systems the reference
+benchmarks against on 16xV100 (reference README.md:197-205 plots relative
+throughput on that hardware; no absolute numbers are published, so the
+per-chip V100 figure anchors the comparison).
+"""
+
+import json
+import time
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 360.0  # ResNet-50 fp32 on V100
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kungfu_tpu.models import ResNet50
+    from kungfu_tpu.optimizers import sync_sgd
+    from kungfu_tpu.parallel import (
+        build_train_step_with_state,
+        data_mesh,
+        init_worker_state,
+        replicate_to_workers,
+        shard_batch,
+    )
+
+    n_chips = jax.device_count()
+    platform = jax.devices()[0].platform
+    per_chip_batch = 128 if platform != "cpu" else 8
+    image = 224 if platform != "cpu" else 64
+    warmup, iters = (3, 20) if platform != "cpu" else (1, 3)
+
+    mesh = data_mesh(n_chips)
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    global_batch = per_chip_batch * n_chips
+    x = jnp.ones((global_batch, image, image, 3), jnp.float32)
+    y = jnp.zeros((global_batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
+
+    def loss_fn(params, batch_stats, batch):
+        logits, updated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["x"], train=True, mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+        return loss, updated["batch_stats"]
+
+    tx = sync_sgd(optax.sgd(0.1, momentum=0.9))
+    params_s = replicate_to_workers(variables["params"], mesh)
+    stats_s = replicate_to_workers(variables["batch_stats"], mesh)
+    opt_s = init_worker_state(tx, params_s, mesh)
+    step = build_train_step_with_state(loss_fn, tx, mesh)
+    batch_s = shard_batch({"x": x, "y": y}, mesh)
+
+    for _ in range(warmup):
+        params_s, stats_s, opt_s, loss = step(params_s, stats_s, opt_s,
+                                              batch_s)
+    # device->host fetch, not block_until_ready: on relayed backends (axon)
+    # block_until_ready returns before execution completes, which would
+    # report absurd throughput; a scalar fetch is a true execution fence
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params_s, stats_s, opt_s, loss = step(params_s, stats_s, opt_s,
+                                              batch_s)
+    final_loss = float(loss)  # fences the whole dependent step chain
+    dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "NaN loss in benchmark"
+
+    images_per_sec = global_batch * iters / dt
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet50_syncsgd_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+        "details": {
+            "platform": platform,
+            "chips": n_chips,
+            "per_chip_batch": per_chip_batch,
+            "image_size": image,
+            "iters": iters,
+            "dtype": "bfloat16",
+            "step_time_ms": round(1000 * dt / iters, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""Communication and training microbenchmarks.
+
+`python -m kungfu_tpu.benchmarks --method CPU|ICI --model
+resnet50-imagenet [--fuse] [--mode par|seq]` reports all-reduce
+throughput over a fake-model tensor catalog, mirroring the reference's
+harnesses (reference: tests/go/cmd/kungfu-bench-allreduce,
+srcs/python/kungfu/tensorflow/v1/benchmarks/__main__.py). Method CPU runs
+the libkf control plane (launch under kfrun for np>1); method ICI runs
+jax psum over the visible device mesh.
+"""

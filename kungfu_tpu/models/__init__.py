@@ -1,0 +1,31 @@
+"""Model zoo for benchmarks and examples.
+
+The reference benchmarks synthetic training on ResNet-50 / VGG16 /
+InceptionV3 / BERT tensor catalogs (reference: benchmarks/system/,
+srcs/python/kungfu/tensorflow/v1/benchmarks/model_sizes.py,
+tests/go/fakemodel/). Here the models are real flax modules — TPU-first:
+bfloat16 activations by default, channels-last layouts, shapes aligned to
+the 128x128 MXU — and the "fake model" tensor catalogs are derived from
+the real modules via jax.eval_shape, so microbenchmarks and unit tests
+stay in exact parity with the architectures.
+"""
+
+from .bert import BertConfig, BertEncoder
+from .fake_models import fake_model_catalog, model_param_sizes
+from .mlp import MLP, SLP
+from .resnet import ResNet, ResNet18, ResNet50, ResNet101
+from .vgg import VGG16
+
+__all__ = [
+    "SLP",
+    "MLP",
+    "ResNet",
+    "ResNet18",
+    "ResNet50",
+    "ResNet101",
+    "VGG16",
+    "BertConfig",
+    "BertEncoder",
+    "fake_model_catalog",
+    "model_param_sizes",
+]

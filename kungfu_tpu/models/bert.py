@@ -1,0 +1,66 @@
+"""BERT encoder — the transformer benchmark model.
+
+The reference benchmarks all-reduce over BERT's tensor catalog
+(reference: srcs/python/kungfu/tensorflow/v1/benchmarks/model_sizes.py,
+tests/cpp/integration/bert.hpp). Here it is a real flax encoder:
+bfloat16 matmuls sized for the MXU (hidden 768 = 6x128, heads 12x64),
+f32 layernorm/softmax accumulations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    dtype: Any = jnp.bfloat16
+
+
+class TransformerLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        c = self.config
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=c.num_heads,
+            dtype=c.dtype,
+            qkv_features=c.hidden_size,
+        )(y, y, mask=mask)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.Dense(c.intermediate_size, dtype=c.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(c.hidden_size, dtype=c.dtype)(y)
+        return x + y
+
+
+class BertEncoder(nn.Module):
+    """Token ids -> contextual embeddings [+ MLM-style logits head]."""
+
+    config: BertConfig = BertConfig()  # frozen dataclass: hashable default
+
+    @nn.compact
+    def __call__(self, token_ids, mask=None):
+        c = self.config
+        pos = jnp.arange(token_ids.shape[-1])[None, :]
+        x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype)(token_ids)
+        x = x + nn.Embed(c.max_position, c.hidden_size,
+                         dtype=c.dtype)(pos)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        for _ in range(c.num_layers):
+            x = TransformerLayer(c)(x, mask=mask)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(c.vocab_size, dtype=jnp.float32)(x)

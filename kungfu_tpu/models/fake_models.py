@@ -1,0 +1,68 @@
+"""Fake-model tensor catalogs for ML-free communication benchmarks.
+
+The reference registers hand-written tensor-size lists per architecture
+(reference: tests/go/fakemodel/fakemodel.go:12-17, resnet50-imagenet.go,
+vgg16-imagenet.go, bert.go). Here the catalogs are *derived* from the
+real flax modules with jax.eval_shape — zero FLOPs, no weights
+materialized — so the microbenchmark traffic pattern is exactly the real
+model's parameter set and can never drift from the architecture.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@lru_cache(maxsize=None)
+def model_param_sizes(name: str) -> List[Tuple[str, Tuple[int, ...]]]:
+    """[(param_path, shape), ...] for a named catalog model."""
+    from . import MLP, SLP, BertConfig, BertEncoder, ResNet50, VGG16
+
+    def shapes_of(module, sample):
+        variables = jax.eval_shape(
+            lambda: module.init(jax.random.PRNGKey(0), sample))
+        out = []
+        flat = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+        for path, leaf in flat:
+            key = "/".join(str(p.key) for p in path
+                           if hasattr(p, "key"))
+            out.append((key, tuple(leaf.shape)))
+        return out
+
+    img = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    if name == "resnet50-imagenet":
+        return shapes_of(ResNet50(num_classes=1000), img)
+    if name == "vgg16-imagenet":
+        return shapes_of(VGG16(num_classes=1000), img)
+    if name == "bert-base":
+        cfg = BertConfig(num_layers=12)
+        return shapes_of(BertEncoder(cfg),
+                         jnp.zeros((1, 128), jnp.int32))
+    if name == "mlp-mnist":
+        return shapes_of(MLP(), jnp.zeros((1, 28, 28, 1), jnp.float32))
+    if name == "slp-mnist":
+        return shapes_of(SLP(), jnp.zeros((1, 28, 28, 1), jnp.float32))
+    raise ValueError(f"unknown fake model: {name}")
+
+
+CATALOG = ["resnet50-imagenet", "vgg16-imagenet", "bert-base", "mlp-mnist",
+           "slp-mnist"]
+
+
+def fake_model_catalog(name: str, fuse: bool = False) -> Dict[str, int]:
+    """{tensor_name: element_count}; fuse=True packs everything into one
+    buffer like the reference's fused mode (fakemodel.go:53-57)."""
+    sizes = model_param_sizes(name)
+    counts = {}
+    for key, shape in sizes:
+        n = 1
+        for d in shape:
+            n *= d
+        counts[key] = n
+    if fuse:
+        return {f"{name}-fused": sum(counts.values())}
+    return counts

@@ -1,0 +1,36 @@
+"""MNIST-scale models: the reference's example workloads.
+
+SLP matches the single-layer perceptron of the reference's MNIST examples
+(reference: examples/tf2_mnist_gradient_tape.py — the v0 end-to-end
+slice); MLP is the deeper variant used in convergence tests.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SLP(nn.Module):
+    """Single-layer perceptron: flatten -> dense softmax head."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 128)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = nn.relu(nn.Dense(f)(x))
+        return nn.Dense(self.num_classes)(x)

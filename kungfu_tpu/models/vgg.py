@@ -1,0 +1,36 @@
+"""VGG16 — the bandwidth-heavy benchmark model.
+
+VGG's ~138M parameters make it the all-reduce stress test in the
+reference's scalability benchmarks (reference: benchmarks/system/
+README.md). bfloat16 activations, NHWC, f32 classifier head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    stage_filters: Sequence[int] = (64, 128, 256, 512, 512)
+    stage_convs: Sequence[int] = (2, 2, 3, 3, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for filters, convs in zip(self.stage_filters, self.stage_convs):
+            for _ in range(convs):
+                x = nn.Conv(filters, (3, 3), padding="SAME",
+                            dtype=self.dtype)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

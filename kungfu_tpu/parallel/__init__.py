@@ -19,7 +19,8 @@ from .mesh import (
     worker_sharding,
 )
 from .pair_host import PairAveragingHost
-from .train import build_eval_step, build_train_step
+from .train import (build_eval_step, build_train_step,
+                    build_train_step_with_state)
 
 __all__ = [
     "data_mesh",
@@ -32,5 +33,6 @@ __all__ = [
     "worker_sharding",
     "build_train_step",
     "build_eval_step",
+    "build_train_step_with_state",
     "PairAveragingHost",
 ]

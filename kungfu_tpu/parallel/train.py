@@ -19,6 +19,64 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _squeeze(t):
+    return jax.tree_util.tree_map(lambda x: x[0], t)
+
+
+def _unsqueeze(t):
+    return jax.tree_util.tree_map(lambda x: x[None], t)
+
+
+def build_train_step_with_state(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = "data",
+    donate: bool = True,
+    sync_state: bool = True,
+):
+    """Compile a train step for models with non-trainable state
+    (BatchNorm running stats etc.).
+
+    `loss_fn(params, model_state, batch) -> (loss, new_model_state)`.
+    Model state is worker-stacked alongside params. With `sync_state=True`
+    (right for sync_sgd and monitors) the model state is pmean'd so every
+    worker carries identical statistics; pass `sync_state=False` for the
+    divergent-row optimizers (sma, pair_averaging, ada before the switch)
+    where each worker's statistics must follow its own weights. Returns
+    `step(params, model_state, opt_state, batch) ->
+        (params, model_state, opt_state, mean_loss)`.
+    """
+
+    def device_step(params_s, mstate_s, opt_s, batch):
+        params = _squeeze(params_s)
+        mstate = _squeeze(mstate_s)
+        opt_state = _squeeze(opt_s)
+        (loss, new_mstate), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, mstate, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if sync_state:
+            new_mstate = jax.tree_util.tree_map(
+                lambda x: lax.pmean(x, axis_name), new_mstate)
+        return (
+            _unsqueeze(params),
+            _unsqueeze(new_mstate),
+            _unsqueeze(opt_state),
+            lax.pmean(loss, axis_name),
+        )
+
+    mapped = shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name), P(axis_name), P()),
+        check_vma=False,
+    )
+    donate_argnums: Tuple[int, ...] = (0, 1, 2) if donate else ()
+    return jax.jit(mapped, donate_argnums=donate_argnums)
+
+
 def build_train_step(
     loss_fn: Callable,
     tx: optax.GradientTransformation,
@@ -31,31 +89,24 @@ def build_train_step(
     `loss_fn(params, batch) -> scalar` sees one worker's (unstacked) params
     and its local batch shard. Returns
     `step(params, opt_state, batch) -> (params, opt_state, mean_loss)`.
+
+    Thin adapter over build_train_step_with_state with empty model state,
+    so the two builders cannot drift.
     """
-    squeeze = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
-    unsqueeze = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-
-    def device_step(params_s, opt_s, batch):
-        params = squeeze(params_s)
-        opt_state = squeeze(opt_s)
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return (
-            unsqueeze(params),
-            unsqueeze(opt_state),
-            lax.pmean(loss, axis_name),
-        )
-
-    mapped = shard_map(
-        device_step,
-        mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
-        out_specs=(P(axis_name), P(axis_name), P()),
-        check_vma=False,
+    stateful = build_train_step_with_state(
+        lambda p, s, b: (loss_fn(p, b), s),
+        tx,
+        mesh,
+        axis_name=axis_name,
+        donate=donate,
+        sync_state=False,  # empty state: nothing to sync
     )
-    donate_argnums: Tuple[int, ...] = (0, 1) if donate else ()
-    return jax.jit(mapped, donate_argnums=donate_argnums)
+
+    def step(params_s, opt_s, batch):
+        params_s, _, opt_s, loss = stateful(params_s, {}, opt_s, batch)
+        return params_s, opt_s, loss
+
+    return step
 
 
 def build_eval_step(

@@ -368,3 +368,72 @@ class TestMonitorEdgeCases:
             st, batch_small=8, batch_big=64,
             g_sq_small=jnp.asarray(2.0), g_sq_big=jnp.asarray(1.0))
         assert np.isfinite(float(ns))
+
+
+class TestTrainStepWithState:
+    def test_state_rows_identical_and_matches_serial(self, mesh):
+        """Sync training with model state: params AND state rows stay
+        identical across workers, and both match a serial large-batch
+        step computed by hand."""
+        from kungfu_tpu.parallel import build_train_step_with_state
+
+        params, batch = make_problem(12)
+        lr = 0.1
+        tx = sync_sgd(optax.sgd(lr))
+
+        # model state: a running mean of predictions (BatchNorm-like)
+        def loss_fn(p, mstate, b):
+            pred = b["x"] @ p["w"] + p["b"]
+            loss = jnp.mean((pred - b["y"]) ** 2)
+            new_state = {"running": 0.9 * mstate["running"]
+                         + 0.1 * jnp.mean(pred)}
+            return loss, new_state
+
+        mstate = {"running": jnp.zeros(())}
+        params_s = replicate_to_workers(params, mesh)
+        mstate_s = replicate_to_workers(mstate, mesh)
+        opt_s = init_worker_state(tx, params_s, mesh)
+        step = build_train_step_with_state(loss_fn, tx, mesh, donate=False)
+        batch_s = shard_batch(batch, mesh)
+        params_s, mstate_s, opt_s, loss = step(params_s, mstate_s, opt_s,
+                                               batch_s)
+
+        running = np.asarray(mstate_s["running"])
+        assert np.allclose(running, running[0])  # rows identical
+        w = np.asarray(params_s["w"])
+        for row in range(1, N):
+            np.testing.assert_allclose(w[row], w[0], rtol=1e-6)
+        # serial check: full-batch grad step
+        g = jax.grad(lambda p: mse_loss(p, batch))(params)
+        np.testing.assert_allclose(
+            w[0], np.asarray(params["w"]) - lr * np.asarray(g["w"]),
+            rtol=1e-5, atol=1e-6)
+        # state pmean: running mean of the *global* prediction mean
+        pred = np.asarray(batch["x"]) @ np.asarray(params["w"]) \
+            + np.asarray(params["b"])
+        np.testing.assert_allclose(running[0], 0.1 * pred.mean(),
+                                   rtol=1e-5)
+
+    def test_sync_state_false_keeps_rows_divergent(self, mesh):
+        from kungfu_tpu.parallel import build_train_step_with_state
+
+        params, batch = make_problem(13)
+        tx = sync_sgd(optax.sgd(0.0))
+
+        def loss_fn(p, mstate, b):
+            pred = b["x"] @ p["w"] + p["b"]
+            return jnp.mean((pred - b["y"]) ** 2), {
+                "m": jnp.mean(pred)}
+
+        params_s = replicate_to_workers(params, mesh)
+        noise = jax.random.normal(jax.random.PRNGKey(3),
+                                  params_s["w"].shape)
+        params_s = {**params_s, "w": params_s["w"] + noise}
+        mstate_s = replicate_to_workers({"m": jnp.zeros(())}, mesh)
+        opt_s = init_worker_state(tx, params_s, mesh)
+        step = build_train_step_with_state(loss_fn, tx, mesh,
+                                           donate=False, sync_state=False)
+        _, mstate_s, _, _ = step(params_s, mstate_s, opt_s,
+                                 shard_batch(batch, mesh))
+        m = np.asarray(mstate_s["m"])
+        assert not np.allclose(m, m[0])  # per-worker stats diverge
