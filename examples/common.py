@@ -1,0 +1,31 @@
+"""Shared helpers for the examples: synthetic MNIST + simple data loading.
+
+The reference's examples download MNIST (reference:
+srcs/python/kungfu/tensorflow/v1/helpers/mnist.py); this environment has no
+egress, so examples default to a deterministic synthetic MNIST-shaped
+dataset (cluster-separated Gaussians, learnable to high accuracy) and use
+real MNIST from an .npz path when ``--data`` is given.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthetic_mnist(n: int = 8192, seed: int = 0):
+    """(x, y): n 28x28 images in [0,1], 10 linearly separable-ish classes."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, size=n)
+    centers = rng.normal(0.5, 0.5, size=(10, 28 * 28))
+    x = centers[y] + rng.normal(0.0, 0.35, size=(n, 28 * 28))
+    x = np.clip(x, 0.0, 1.0).astype(np.float32).reshape(n, 28, 28, 1)
+    return x, y.astype(np.int32)
+
+
+def load_mnist(path: str = ""):
+    """Real MNIST from an npz with keys x_train/y_train, else synthetic."""
+    if path:
+        d = np.load(path)
+        x = (d["x_train"].astype(np.float32) / 255.0)[..., None]
+        return x, d["y_train"].astype(np.int32)
+    return synthetic_mnist()

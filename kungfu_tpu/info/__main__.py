@@ -1,0 +1,54 @@
+"""`python -m kungfu_tpu.info` — environment report.
+
+Rebuild of the reference's info tool (reference:
+srcs/python/kungfu/info/__main__.py prints CUDA/NCCL/TF versions); here it
+reports the JAX/XLA stack, visible accelerator topology, and the libkf
+control-plane build.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def main():
+    import kungfu_tpu
+
+    print(f"kungfu_tpu {kungfu_tpu.__version__}")
+    try:
+        from kungfu_tpu.ffi import load
+        lib = load()
+        ver = lib.kf_version_string().decode()
+        print(f"libkf {ver}")
+    except Exception as e:  # library missing is a report, not a crash
+        print(f"libkf unavailable: {e}")
+    try:
+        import jax
+        print(f"jax {jax.__version__}")
+        import jaxlib
+        print(f"jaxlib {jaxlib.__version__}")
+        devs = jax.devices()
+        plats = {}
+        for d in devs:
+            plats.setdefault(d.platform, []).append(d)
+        for plat, ds in plats.items():
+            print(f"devices[{plat}] {len(ds)}: "
+                  + ", ".join(str(d) for d in ds[:8])
+                  + (" ..." if len(ds) > 8 else ""))
+        print(f"process_index {jax.process_index()} / {jax.process_count()}")
+    except Exception as e:
+        print(f"jax unavailable: {e}")
+    import flax
+    import optax
+    print(f"flax {flax.__version__}")
+    print(f"optax {optax.__version__}")
+    kf_vars = {k: v for k, v in sorted(os.environ.items())
+               if k.startswith("KF_")}
+    if kf_vars:
+        print("KF_* environment:")
+        for k, v in kf_vars.items():
+            print(f"  {k}={v}")
+
+
+if __name__ == "__main__":
+    main()

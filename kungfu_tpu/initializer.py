@@ -1,0 +1,61 @@
+"""Model-state broadcast at (re)initialization time.
+
+Rebuild of the reference's initializer family (reference:
+srcs/python/kungfu/tensorflow/initializer/__init__.py — the
+BroadcastGlobalVariables Op/Hook/Callback forms): every worker must start
+from rank 0's weights, and joiners after an elastic resize must adopt the
+survivors' weights.
+
+Two paths, mirroring the framework's two planes:
+
+- `broadcast_variables(tree, peer)` — host-side DCN broadcast over libkf.
+  Used at process start and at elastic epoch switches, when workers are
+  separate processes and the ICI mesh may not exist yet. The pytree is
+  packed into one flat byte buffer (the reference fuses variables the same
+  way, ops/__init__.py:22-39) so the broadcast is a single named message
+  per epoch rather than one per tensor.
+- `kungfu_tpu.parallel.broadcast_params` — in-mesh ICI broadcast for
+  device-sharded state (already compiled into the SPMD program).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .ops.collective import pack_bytes, unpack_bytes
+
+
+def broadcast_variables(tree, peer=None, root: int = 0, name: str = "kf_bcast_vars"):
+    """Broadcast a pytree of arrays from `root` over the control plane.
+
+    Returns the tree every rank agrees on (root's values). No-op for
+    single-worker clusters.
+    """
+    if peer is None:
+        from . import peer as _default
+        peer = _default()
+    if peer.size <= 1:
+        return tree
+    buf = pack_bytes(tree)
+    out = peer.broadcast(buf, root=root, name=name)
+    return unpack_bytes(out, tree)
+
+
+class BroadcastGlobalVariablesCallback:
+    """Keras-style callback form: broadcast once after the first batch.
+
+    The reference defers the TF2 broadcast to after the first trained batch
+    so optimizer slots exist (initializer/__init__.py:65-90); here the same
+    hook shape lets training loops sync params+opt-state lazily.
+    """
+
+    def __init__(self, peer=None, root: int = 0):
+        self.peer = peer
+        self.root = root
+        self._done = False
+
+    def on_batch_end(self, tree):
+        if self._done:
+            return tree
+        self._done = True
+        return broadcast_variables(tree, peer=self.peer, root=self.root)

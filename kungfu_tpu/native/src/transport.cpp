@@ -242,10 +242,21 @@ std::shared_ptr<Client::Conn> Client::get(const PeerID &dest, ConnType t) {
 int Client::ensure_connected(Conn *c, const PeerID &dest, ConnType t) {
     if (c->fd >= 0) return KF_OK;
     int last = KF_ERR_CONN;
+    int epoch_misses = 0;
     for (int i = 0; i <= connect_retries; i++) {
         last = dial(dest, t);
         if (last >= 0) break;
-        if (last == KF_ERR_EPOCH) return last;  // retrying won't help
+        // KF_ERR_EPOCH gets a short retry budget of its own: during a
+        // resize, peers switch to the new cluster version at slightly
+        // different times, so a dial from the new epoch can race a remote
+        // that has not yet bumped its token (the reference retries through
+        // this window, connection.go:81-87 + config.go:16-18); each
+        // re-dial re-reads our own token, healing the laggard case too.
+        // But a *persistently* mismatched token means this worker is
+        // genuinely stale (e.g. evicted), and must fail fast rather than
+        // burn the full dial-patience loop while holding the conn mutex.
+        if (last == KF_ERR_EPOCH && ++epoch_misses > epoch_retries)
+            return last;
         std::this_thread::sleep_for(
             std::chrono::milliseconds(connect_retry_ms));
     }

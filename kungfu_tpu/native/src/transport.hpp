@@ -131,6 +131,8 @@ class Client {
     // Drop connections to peers outside `keep` and adopt the new token.
     void reset(const std::vector<PeerID> &keep, uint32_t token);
 
+    int epoch_retries = 20;         // epoch-token mismatch budget (resize
+                                    // convergence window), then fail fast
     int connect_retries = 120;      // x period = dial patience for peers
     int connect_retry_ms = 250;     // that are still starting up
 

@@ -31,6 +31,15 @@ from .monitor import (
     update_noise_scale,
     update_noise_scale_from_sq,
 )
+from .state import CounterState, EMAState, counter, ema
+from .topology import (
+    all_gather_latency_matrix,
+    get_neighbour,
+    get_peer_latencies,
+    minimum_spanning_tree,
+    neighbour_mask,
+    round_robin,
+)
 
 __all__ = [
     "all_reduce",
@@ -51,4 +60,14 @@ __all__ = [
     "update_noise_scale_from_sq",
     "tree_sq_norm",
     "gradient_variance",
+    "CounterState",
+    "EMAState",
+    "counter",
+    "ema",
+    "get_peer_latencies",
+    "all_gather_latency_matrix",
+    "minimum_spanning_tree",
+    "neighbour_mask",
+    "get_neighbour",
+    "round_robin",
 ]

@@ -1,0 +1,55 @@
+"""Stateful scalar helpers: step counter and exponential moving average.
+
+The reference implements these as stateful TF kernels
+(reference: srcs/cpp/src/tensorflow/ops/cpu/state.cpp:6-78 KungfuCounter /
+KungfuExponentialMovingAverage; srcs/cpp/include/kungfu/utils/ema.hpp).
+In JAX state is explicit, so they become pure update functions over
+NamedTuple state — jit/scan friendly, no hidden resource variables.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class CounterState(NamedTuple):
+    value: jnp.ndarray  # int32
+
+
+def counter(init: int = 0, incr: int = 1):
+    """Returns (init_state, update) — update bumps and returns the *pre*
+    increment value, matching the reference kernel's semantics."""
+
+    def init_fn() -> CounterState:
+        return CounterState(value=jnp.asarray(init, jnp.int32))
+
+    def update(state: CounterState):
+        return state.value, CounterState(value=state.value + incr)
+
+    return init_fn, update
+
+
+class EMAState(NamedTuple):
+    value: jnp.ndarray   # running average (bias-corrected on read)
+    count: jnp.ndarray   # int32 number of updates
+
+
+def ema(alpha: float):
+    """Bias-corrected EMA: value_t = a*value + (1-a)*x, read corrected by
+    1/(1-a^t) (reference: ema.hpp bias correction)."""
+    a = float(alpha)
+
+    def init_fn(like=0.0) -> EMAState:
+        return EMAState(value=jnp.zeros_like(jnp.asarray(like, jnp.float32)),
+                        count=jnp.asarray(0, jnp.int32))
+
+    def update(state: EMAState, x):
+        x = jnp.asarray(x, jnp.float32)
+        count = state.count + 1
+        value = a * state.value + (1.0 - a) * x
+        corrected = value / (1.0 - a ** count.astype(jnp.float32))
+        return corrected, EMAState(value=value, count=count)
+
+    return init_fn, update

@@ -10,6 +10,7 @@ separately in kungfu_tpu.parallel — this class is pure DCN control.
 from __future__ import annotations
 
 import json
+import os
 import time
 import urllib.request
 from typing import Optional, Tuple
@@ -74,6 +75,7 @@ class Peer:
         self._workers = self.config.init_peers
         self._version = self.config.version
         self._started = False
+        self._metrics = None
         if self.config.single_process:
             self._native = None
         else:
@@ -95,10 +97,26 @@ class Peer:
             # reference blocks in updateTo's Barrier until the whole
             # cluster is up (peer.go:137-159)
             self._native.barrier()
+        if os.environ.get("KF_ENABLE_MONITORING"):
+            # reference serves /metrics on peer port + 10000
+            # (monitor/server.go:15-25, peer.go:89-97)
+            from .monitor import METRICS_PORT_OFFSET, MetricsServer
+            port = self.config.self_id.port + METRICS_PORT_OFFSET
+            if port > 65535:
+                print(f"[kf] monitoring disabled: metrics port {port} "
+                      "out of range (peer port too high)", flush=True)
+            else:
+                try:
+                    self._metrics = MetricsServer(self, port).start()
+                except OSError as e:
+                    print(f"[kf] monitoring disabled: {e}", flush=True)
         self._started = True
         return self
 
     def stop(self):
+        if self._metrics is not None:
+            self._metrics.stop()
+            self._metrics = None
         if self._native is not None:
             self._native.stop()
         self._started = False

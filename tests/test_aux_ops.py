@@ -1,0 +1,194 @@
+"""Unit tests for topology/MST, state ops, dataset adaptor, monitor.
+
+Mirrors the reference's pure-logic test tier (reference: test_mst.cpp,
+cpu/state.cpp kernels, datasets/adaptor.py, monitor/counters_test.go).
+"""
+
+import urllib.request
+
+import numpy as np
+
+from kungfu_tpu.data import ElasticSampler, shard_slice
+from kungfu_tpu.monitor import MetricsServer
+from kungfu_tpu.ops.state import counter, ema
+from kungfu_tpu.ops.topology import (
+    minimum_spanning_tree,
+    neighbour_mask,
+    round_robin,
+)
+
+
+class TestMST:
+    def test_line_graph(self):
+        # latencies make 0-1-2-3 a chain
+        w = np.array([
+            [0, 1, 10, 10],
+            [1, 0, 1, 10],
+            [10, 1, 0, 1],
+            [10, 10, 1, 0],
+        ], float)
+        edges = minimum_spanning_tree(w)
+        assert edges.shape == (3, 2)
+        got = {tuple(sorted(e)) for e in edges.tolist()}
+        assert got == {(0, 1), (1, 2), (2, 3)}
+
+    def test_asymmetric_uses_min_direction(self):
+        w = np.array([[0, 100], [1, 0]], float)
+        edges = minimum_spanning_tree(w)
+        assert edges.tolist() == [[0, 1]]
+
+    def test_star_is_cheapest(self):
+        n = 5
+        w = np.full((n, n), 10.0)
+        w[0, :] = 1.0
+        w[:, 0] = 1.0
+        np.fill_diagonal(w, 0)
+        edges = minimum_spanning_tree(w)
+        assert all(0 in e for e in edges.tolist())
+
+    def test_trivial_sizes(self):
+        assert minimum_spanning_tree(np.zeros((1, 1))).shape == (0, 2)
+
+    def test_neighbour_mask(self):
+        edges = np.array([[0, 1], [1, 2], [2, 3]])
+        assert neighbour_mask(edges, 4, 1).tolist() == [True, False, True,
+                                                        False]
+        assert neighbour_mask(edges, 4, 3).tolist() == [False, False, True,
+                                                        False]
+
+
+class TestRoundRobin:
+    def test_cycles_through_true_entries(self):
+        mask = [True, False, True, True]
+        state = 0
+        picks = []
+        for _ in range(6):
+            choice, state = round_robin(mask, state)
+            picks.append(choice)
+        assert picks == [2, 3, 0, 2, 3, 0]
+
+    def test_empty_mask(self):
+        choice, state = round_robin([False, False], 0)
+        assert choice == -1 and state == 0
+
+
+class TestStateOps:
+    def test_counter_returns_pre_increment(self):
+        init, update = counter()
+        s = init()
+        v0, s = update(s)
+        v1, s = update(s)
+        assert (int(v0), int(v1), int(s.value)) == (0, 1, 2)
+
+    def test_ema_bias_correction(self):
+        init, update = ema(0.9)
+        s = init()
+        # constant input: corrected EMA must equal the input immediately
+        v, s = update(s, 5.0)
+        assert abs(float(v) - 5.0) < 1e-4
+        v, s = update(s, 5.0)
+        assert abs(float(v) - 5.0) < 1e-4
+
+
+class TestElasticSampler:
+    def test_disjoint_cover_across_ranks(self):
+        n, b = 100, 10
+        samplers = [ElasticSampler(n, b, r, 2, seed=7) for r in range(2)]
+        seen = np.concatenate([s.next_indices() for s in samplers])
+        assert len(set(seen.tolist())) == 20  # no overlap within a batch
+
+    def test_resize_resumes_without_replay(self):
+        n, b = 64, 8
+        # phase 1: 2 workers, 3 global batches
+        phase1 = [ElasticSampler(n, b, r, 2, seed=3) for r in range(2)]
+        consumed = []
+        for _ in range(3):
+            for s in phase1:
+                consumed.extend(s.next_indices().tolist())
+        offset = phase1[0].offset
+        assert offset == 3 * 16
+        # resize to 4 workers at the agreed offset
+        phase2 = [ElasticSampler(n, b, r, 4, seed=3, offset=offset)
+                  for r in range(4)]
+        nxt = np.concatenate([s.next_indices() for s in phase2])
+        # the next global batch continues the same global order a
+        # non-resized 1-worker run would produce
+        ref = ElasticSampler(n, 32, 0, 1, seed=3)
+        ref.offset = offset
+        assert sorted(nxt.tolist()) == sorted(ref.next_indices().tolist())
+
+    def test_epoch_boundary_reshuffles(self):
+        n, b = 10, 10
+        s = ElasticSampler(n, b, 0, 1, seed=1)
+        e0 = s.next_indices()
+        e1 = s.next_indices()
+        assert sorted(e0.tolist()) == list(range(10))
+        assert sorted(e1.tolist()) == list(range(10))
+        assert e0.tolist() != e1.tolist()
+
+    def test_no_shuffle_is_sequential(self):
+        s = ElasticSampler(10, 4, 0, 1, shuffle=False)
+        assert s.next_indices().tolist() == [0, 1, 2, 3]
+
+    def test_shard_slice_covers(self):
+        parts = [shard_slice(11, r, 3) for r in range(3)]
+        assert parts[0][0] == 0 and parts[-1][1] == 11
+        for (b0, e0), (b1, e1) in zip(parts, parts[1:]):
+            assert e0 == b1
+
+
+class TestMultiPeerTopology:
+    def test_latency_mst_and_broadcast_vars(self):
+        from kungfu_tpu.initializer import broadcast_variables
+        from kungfu_tpu.ops.topology import (
+            all_gather_latency_matrix,
+            get_neighbour,
+        )
+        from test_peer_api import make_peer_cluster, run_on_all
+
+        peers = make_peer_cluster(3, 23500)
+        try:
+            run_on_all(peers, lambda p, i: p.start())
+
+            def work(p, rank):
+                m = all_gather_latency_matrix(p)
+                nbrs = get_neighbour(p, m)
+                tree = {"w": np.full((4,), float(rank), np.float32),
+                        "b": np.array([rank], np.int32)}
+                out = broadcast_variables(tree, peer=p, root=1)
+                return m, nbrs, out
+
+            results = run_on_all(peers, work)
+            for m, nbrs, out in results:
+                assert m.shape == (3, 3)
+                assert all(m[i, i] == 0 for i in range(3))
+                assert 0 < len(nbrs) <= 2
+                # all ranks adopt root-1's values
+                np.testing.assert_array_equal(
+                    out["w"], np.full((4,), 1.0, np.float32))
+                assert out["b"].tolist() == [1]
+            # every rank agreed on the same matrix => same MST
+            np.testing.assert_array_equal(results[0][0], results[1][0])
+        finally:
+            for p in peers:
+                p.close()
+
+
+class _FakePeer:
+    rank = 0
+
+    def stats(self):
+        return {"egress_bytes": 123, "ingress_bytes": 456}
+
+
+def test_metrics_endpoint():
+    srv = MetricsServer(_FakePeer(), port=0).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+        assert 'kf_egress_bytes_total{rank="0"} 123' in body
+        assert 'kf_ingress_bytes_total{rank="0"} 456' in body
+        assert "kf_egress_bytes_per_sec" in body
+    finally:
+        srv.stop()
