@@ -1,0 +1,85 @@
+"""Training-health monitoring: gradient noise scale + gradient variance.
+
+The monitoring optimizers are S-SGD plus an online statistic kept in
+optimizer state (reference: srcs/python/kungfu/tensorflow/optimizers/
+{grad_noise_scale,grad_variance}.py and the NoiseScale EMA kernel,
+srcs/cpp/src/tensorflow/ops/cpu/collective.cpp:162-207). The noise scale
+B_noise estimates the largest useful batch size — the signal an adaptive
+trainer uses to propose a new cluster size.
+
+Run:  python examples/mnist_noise_scale.py --monitor noise-scale
+"""
+
+import argparse
+
+import jax
+import optax
+
+from common import load_mnist
+
+from kungfu_tpu.data import ElasticSampler
+from kungfu_tpu.models import SLP
+from kungfu_tpu.optimizers import (
+    monitor_gradient_noise_scale,
+    monitor_gradient_variance,
+)
+from kungfu_tpu.parallel import (
+    build_train_step,
+    data_mesh,
+    init_worker_state,
+    replicate_to_workers,
+    shard_batch,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--monitor", choices=["noise-scale", "variance"],
+                    default="noise-scale")
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=64, help="per-chip batch")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--data", default="", help="path to mnist .npz")
+    args = ap.parse_args()
+
+    x, y = load_mnist(args.data)
+    n_chips = jax.device_count()
+    mesh = data_mesh(n_chips)
+    model = SLP(num_classes=10)
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    inner = optax.sgd(args.lr)
+    if args.monitor == "noise-scale":
+        tx = monitor_gradient_noise_scale(inner,
+                                          device_batch_size=args.batch)
+    else:
+        tx = monitor_gradient_variance(inner)
+
+    params_s = replicate_to_workers(params, mesh)
+    opt_s = init_worker_state(tx, params_s, mesh)
+    step = build_train_step(loss_fn, tx, mesh)
+
+    sampler = ElasticSampler(len(x), args.batch * n_chips, rank=0, size=1,
+                             seed=1)
+    for i in range(args.steps):
+        idx = sampler.next_indices()
+        batch = shard_batch({"x": x[idx], "y": y[idx]}, mesh)
+        params_s, opt_s, loss = step(params_s, opt_s, batch)
+        if i % 50 == 0 or i == args.steps - 1:
+            if args.monitor == "noise-scale":
+                stat = float(opt_s.noise_scale[0])
+                label = "B_noise"
+            else:
+                stat = float(opt_s.variance[0])
+                label = "grad-var"
+            print(f"step {i} loss {float(loss):.4f} {label} {stat:.3f} "
+                  f"(chips={n_chips})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

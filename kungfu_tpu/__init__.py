@@ -24,6 +24,7 @@ from __future__ import annotations
 import atexit
 from typing import Optional
 
+from .ffi import OrderGroup
 from .peer import Peer
 
 __version__ = "0.1.0"

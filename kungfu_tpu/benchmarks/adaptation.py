@@ -1,0 +1,109 @@
+"""Adaptation benchmark: wall-clock cost of an online cluster resize.
+
+Measures what the reference's adaptive fake trainer measures per resize
+(reference: tests/go/cmd/kungfu-fake-adaptive-trainer, timing around the
+resize call; benchmarks/adaptation/): the time from the step that triggers
+a schedule-driven resize proposal to the first step of the new epoch —
+i.e. propose + config-server round trip + digest consensus + runner churn
++ epoch barrier + state resync.
+
+Driver:  python -m kungfu_tpu.benchmarks.adaptation --launch \\
+             [--schedule 3:2,3:4,3:1] [--np 2] [--payload-mb 4]
+Worker (spawned by the driver under kfrun -w): same module, no --launch.
+
+Prints one line per resize: `resize <from>-><to> <ms> ms` and a final
+summary on the surviving rank.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def worker(args) -> int:
+    import kungfu_tpu
+    from kungfu_tpu.elastic import ElasticCallback
+
+    p = kungfu_tpu.init()
+    elastic = ElasticCallback(p, schedule=args.schedule, samples_per_step=1)
+    # A model-sized payload so the joiner broadcast cost is realistic.
+    payload = np.zeros(args.payload_mb * 2**20 // 4, dtype=np.float32)
+    if p.config.version > 0:
+        elastic.sync_position()
+    resize_ms = []
+    while elastic.state.step < args.steps:
+        out = p.all_reduce(np.ones(4, np.float32),
+                           name=f"work:{p.version}:{elastic.state.step}")
+        assert out[0] == p.size
+        old_size = p.size
+        t0 = time.perf_counter()
+        if elastic.after_step():
+            if not elastic.state.keep:
+                return 0  # evicted
+            payload = elastic.resync_params(payload)
+            ms = (time.perf_counter() - t0) * 1e3
+            resize_ms.append(ms)
+            print(f"resize {old_size}->{p.size} {ms:.1f} ms", flush=True)
+    if p.rank == 0 and resize_ms:
+        print(
+            f"adaptation np0={args.np} resizes={len(resize_ms)} "
+            f"payload={args.payload_mb}MiB "
+            f"mean={np.mean(resize_ms):.1f} ms "
+            f"max={np.max(resize_ms):.1f} ms",
+            flush=True,
+        )
+    return 0
+
+
+def launch(args) -> int:
+    import subprocess
+
+    from kungfu_tpu.elastic import ConfigServer
+
+    server = ConfigServer(port=0).start()
+    try:
+        env = dict(os.environ)
+        env.setdefault("KF_TIMEOUT_MS", "60000")
+        env.setdefault("KF_LOG_LEVEL", "warn")
+        # control-plane-only workers: no accelerator needed, and the
+        # benchmark must not serialize on the machine's single TPU
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [
+            sys.executable, "-m", "kungfu_tpu.run",
+            "-np", str(args.np), "-H", f"127.0.0.1:{args.max_np}",
+            "-port-range", args.port_range,
+            "-w", "-config-server", server.get_url,
+            "-logdir", args.logdir,
+            "--", sys.executable, "-m", "kungfu_tpu.benchmarks.adaptation",
+            "--schedule", args.schedule, "--steps", str(args.steps),
+            "--payload-mb", str(args.payload_mb), "--np", str(args.np),
+        ]
+        return subprocess.call(cmd, env=env)
+    finally:
+        server.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--launch", action="store_true",
+                    help="boot config server + elastic kfrun around self")
+    ap.add_argument("--schedule", default="3:2,3:4,3:1",
+                    help="steps:size,... resize schedule")
+    ap.add_argument("--steps", type=int, default=9)
+    ap.add_argument("--np", type=int, default=2, help="initial cluster size")
+    ap.add_argument("--max-np", type=int, default=8, help="host slot count")
+    ap.add_argument("--payload-mb", type=int, default=4,
+                    help="joiner-broadcast payload size")
+    ap.add_argument("--port-range", default="27000-27999")
+    ap.add_argument("--logdir", default=".kf-adaptation-logs")
+    args = ap.parse_args(argv)
+    return launch(args) if args.launch else worker(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

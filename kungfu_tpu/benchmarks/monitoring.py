@@ -1,0 +1,91 @@
+"""Monitoring-overhead benchmark: cost of training-health statistics.
+
+The reference measures the throughput overhead of its gradient-variance
+and gradient-noise-scale monitoring optimizers against plain S-SGD
+(reference: benchmarks/monitoring/benchmark.py). Here all three are optax
+transforms inside one compiled SPMD step, so the overhead is whatever
+extra FLOPs/collectives XLA could not fuse away.
+
+Run:  python -m kungfu_tpu.benchmarks.monitoring [--model mlp] [--iters 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64, help="per-chip batch")
+    ap.add_argument("--dim", type=int, default=1024,
+                    help="hidden width of the synthetic MLP")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kungfu_tpu.models import MLP
+    from kungfu_tpu.optimizers import (
+        monitor_gradient_noise_scale,
+        monitor_gradient_variance,
+        sync_sgd,
+    )
+    from kungfu_tpu.parallel import (
+        build_train_step,
+        data_mesh,
+        init_worker_state,
+        replicate_to_workers,
+        shard_batch,
+    )
+
+    n = jax.device_count()
+    mesh = data_mesh(n)
+    model = MLP(features=[args.dim, args.dim, 10])
+    x = jnp.ones((args.batch * n, args.dim), jnp.float32)
+    y = jnp.zeros((args.batch * n,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    variants = {
+        "sync-sgd": sync_sgd(optax.sgd(0.1)),
+        "noise-scale": monitor_gradient_noise_scale(
+            optax.sgd(0.1), device_batch_size=args.batch),
+        "variance": monitor_gradient_variance(optax.sgd(0.1)),
+    }
+    batch = shard_batch({"x": x, "y": y}, mesh)
+    base_ms = None
+    for name, tx in variants.items():
+        params_s = replicate_to_workers(params, mesh)
+        opt_s = init_worker_state(tx, params_s, mesh)
+        step = build_train_step(loss_fn, tx, mesh)
+        for _ in range(args.warmup):
+            params_s, opt_s, loss = step(params_s, opt_s, batch)
+        jax.block_until_ready(params_s)  # fence (works with --warmup 0)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            params_s, opt_s, loss = step(params_s, opt_s, batch)
+        jax.block_until_ready(params_s)
+        ms = (time.perf_counter() - t0) / args.iters * 1e3
+        if base_ms is None:
+            base_ms = ms
+        print(
+            f"{name:12s} {ms:8.3f} ms/step  "
+            f"overhead {100.0 * (ms - base_ms) / base_ms:+6.1f}% "
+            f"(chips={n}, batch/chip={args.batch}, dim={args.dim})",
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -70,6 +70,7 @@ _OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
 CONTROL_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64
 )
+TASK_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
 
 class KfError(RuntimeError):
@@ -130,6 +131,12 @@ def load() -> ctypes.CDLL:
         "kf_stats": ([P, ctypes.POINTER(ctypes.c_uint64),
                       ctypes.POINTER(ctypes.c_uint64)], None),
         "kf_version_string": ([], cs),
+        "kf_order_group_new": ([ctypes.c_int, ctypes.POINTER(ctypes.c_int)],
+                               P),
+        "kf_order_group_start": ([P, ctypes.c_int, TASK_CB, P], ctypes.c_int),
+        "kf_order_group_wait": ([P, ctypes.POINTER(ctypes.c_int)],
+                                ctypes.c_int),
+        "kf_order_group_free": ([P], None),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -155,6 +162,92 @@ def op_code(op: str) -> int:
 
 def _buf_ptr(a: np.ndarray) -> ctypes.c_void_p:
     return ctypes.c_void_p(a.ctypes.data)
+
+
+class OrderGroup:
+    """Run named async tasks in a fixed schedule order, recording arrival
+    order — the host-side op-ordering engine (reference:
+    srcs/go/ordergroup/ordergroup.go, srcs/cpp/src/python/init.cpp name-keyed
+    wrapper). On TPU the XLA compiler orders on-device collectives, so this
+    orders *control-plane* ops issued from multiple Python threads, which
+    must hit the wire identically on every rank to avoid cross-rank
+    deadlock. `schedule` is the list of task names in execution order."""
+
+    def __init__(self, schedule):
+        import threading
+
+        self._lib = load()
+        self._names = list(schedule)
+        self._index = {n: i for i, n in enumerate(self._names)}
+        if len(self._index) != len(self._names):
+            raise ValueError("duplicate names in schedule")
+        self._h = self._lib.kf_order_group_new(len(self._names), None)
+        if not self._h:
+            raise RuntimeError("kf_order_group_new failed")
+        # Callbacks must outlive their cycle: a cycle's n callbacks are
+        # always a prefix of this list (every start of cycle k precedes
+        # the reset that admits cycle k+1's starts), so wait() drops
+        # exactly the first n without touching next-cycle registrations
+        # racing in from other threads.
+        self._mu = threading.Lock()
+        self._cbs = []
+        self._errors = []  # (name, exception) raised inside tasks
+
+    def start(self, name: str, fn):
+        """Register `fn` to run (on the executor thread) at `name`'s slot."""
+        if self._h is None:
+            raise RuntimeError("order group is closed")
+
+        def trampoline(_user):
+            try:
+                fn()
+            except Exception as e:  # never let exceptions cross into C
+                with self._mu:
+                    self._errors.append((name, e))
+
+        cb = TASK_CB(trampoline)
+        with self._mu:
+            self._cbs.append(cb)
+        try:
+            _check(
+                self._lib.kf_order_group_start(self._h, self._index[name],
+                                               cb, None),
+                f"order_group start {name}",
+            )
+        except Exception:
+            with self._mu:
+                self._cbs.remove(cb)
+            raise
+
+    def wait(self):
+        """Block until every scheduled task ran; return names in the order
+        they arrived (the signal used to re-negotiate the schedule).
+        Raises if any task of the cycle raised — a silently skipped task
+        would leave peer ranks blocked on a never-issued named op."""
+        if self._h is None:
+            raise RuntimeError("order group is closed")
+        out = (ctypes.c_int * len(self._names))()
+        _check(self._lib.kf_order_group_wait(self._h, out),
+               "order_group wait")
+        with self._mu:
+            del self._cbs[:len(self._names)]
+            errors, self._errors = self._errors, []
+        if errors:
+            raise RuntimeError(
+                "order-group task(s) failed: "
+                + "; ".join(f"{n}: {e}" for n, e in errors))
+        return [self._names[i] for i in out]
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.kf_order_group_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class NativePeer:

@@ -125,6 +125,29 @@ int kf_set_control_handler(kf_peer *, kf_control_cb cb, void *user);
 int kf_send_control(kf_peer *, const char *dest_spec, const char *name,
                     const void *data, int64_t n);
 
+/* --- order group --------------------------------------------------------- */
+
+/* Executes N async tasks in a scheduled order regardless of arrival order,
+ * recording actual arrival order (the reference's gradient-ordering engine;
+ * here it serializes host-side async control-plane ops so all ranks issue
+ * named collectives in the same order). Independent of any kf_peer. */
+typedef struct kf_order_group kf_order_group;
+typedef void (*kf_task_cb)(void *user);
+
+/* exec_order: permutation of 0..n-1 (position -> rank), or NULL for rank
+ * order. */
+kf_order_group *kf_order_group_new(int n, const int *exec_order);
+/* Register task `rank` for this cycle; cb(user) runs on the executor
+ * thread in scheduled order. Returns KF_ERR_ARG on bad/duplicate rank. */
+int kf_order_group_start(kf_order_group *, int rank, kf_task_cb cb,
+                         void *user);
+/* Block until all n tasks ran; writes the arrival order (n ints, element i
+ * = rank that arrived i-th) into arrival_out if non-NULL, then resets for
+ * the next cycle. Returns KF_ERR (arrival_out untouched) if a concurrent
+ * wait consumed this cycle's order first. */
+int kf_order_group_wait(kf_order_group *, int *arrival_out);
+void kf_order_group_free(kf_order_group *);
+
 /* --- monitoring --------------------------------------------------------- */
 
 int kf_ping(kf_peer *, int rank, int64_t *rtt_us); /* RTT to peer */

@@ -5,6 +5,7 @@
 #include <shared_mutex>
 #include <string>
 
+#include "ordergroup.hpp"
 #include "peer.hpp"
 
 using namespace kf;
@@ -198,6 +199,45 @@ void kf_stats(kf_peer *p, uint64_t *egress_bytes, uint64_t *ingress_bytes) {
     if (!p) return;
     if (egress_bytes) *egress_bytes = p->impl.counters.egress.load();
     if (ingress_bytes) *ingress_bytes = p->impl.counters.ingress.load();
+}
+
+kf_order_group *kf_order_group_new(int n, const int *exec_order) {
+    if (n < 0) return nullptr;
+    std::vector<int> order;
+    if (exec_order) order.assign(exec_order, exec_order + n);
+    try {
+        return reinterpret_cast<kf_order_group *>(
+            new OrderGroup(n, std::move(order)));
+    } catch (const std::exception &) {
+        return nullptr;
+    }
+}
+
+int kf_order_group_start(kf_order_group *g, int rank, kf_task_cb cb,
+                         void *user) {
+    if (!g || !cb) return KF_ERR_ARG;
+    try {
+        reinterpret_cast<OrderGroup *>(g)->start(rank,
+                                                 [cb, user] { cb(user); });
+    } catch (const std::exception &) {
+        return KF_ERR_ARG;
+    }
+    return KF_OK;
+}
+
+int kf_order_group_wait(kf_order_group *g, int *arrival_out) {
+    if (!g) return KF_ERR_ARG;
+    auto *og = reinterpret_cast<OrderGroup *>(g);
+    std::vector<int> order = og->wait();
+    if (og->size() > 0 && order.empty())
+        return KF_ERR;  // a concurrent wait() consumed this cycle's order
+    if (arrival_out && !order.empty())
+        std::memcpy(arrival_out, order.data(), order.size() * sizeof(int));
+    return KF_OK;
+}
+
+void kf_order_group_free(kf_order_group *g) {
+    delete reinterpret_cast<OrderGroup *>(g);
 }
 
 const char *kf_version_string(void) { return "libkf 0.1.0 (kungfu-tpu)"; }

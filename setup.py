@@ -1,0 +1,61 @@
+"""Packaging for kungfu-tpu: pip-installable Python package + the libkf
+C++ control plane built during the wheel build (reference: setup.py drives
+CMake from pip the same way, /root/reference/setup.py:46-100; here the
+native build is a plain Makefile since libkf has no external deps).
+
+    pip install .          # builds kungfu_tpu/native/libkf.so in-tree
+    kfrun -np 4 -- python train.py
+    kfdistribute -H a:4,b:4 -- ...
+"""
+
+import subprocess
+
+from setuptools import Command, find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+class BuildNative(Command):
+    """Build libkf.so via the native Makefile."""
+
+    description = "build the libkf C++ control plane"
+    user_options = []
+
+    def initialize_options(self):
+        pass
+
+    def finalize_options(self):
+        pass
+
+    def run(self):
+        subprocess.check_call(["make", "-C", "kungfu_tpu/native"])
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        self.run_command("build_native")
+        super().run()
+
+
+setup(
+    name="kungfu-tpu",
+    version="0.1.0",
+    description=(
+        "Adaptive, elastic, decentralized distributed training on TPU "
+        "(JAX/XLA data plane + C++ DCN control plane)"
+    ),
+    packages=find_packages(include=["kungfu_tpu", "kungfu_tpu.*"]),
+    package_data={
+        "kungfu_tpu": ["native/libkf.so", "native/Makefile",
+                       "native/include/*.h", "native/src/*"],
+    },
+    python_requires=">=3.9",
+    install_requires=["numpy", "jax", "flax", "optax"],
+    cmdclass={"build_native": BuildNative, "build_py": BuildPyWithNative},
+    entry_points={
+        "console_scripts": [
+            "kfrun = kungfu_tpu.run.__main__:main",
+            "kfdistribute = kungfu_tpu.run.distribute:main",
+            "kf-config-server = kungfu_tpu.elastic.config_server:main",
+        ],
+    },
+)

@@ -1,0 +1,131 @@
+"""OrderGroup: scheduled-order execution + arrival-order recording.
+
+Mirrors the reference's order-group unit tests (reference:
+srcs/go/ordergroup/ordergroup_test.go, tests/cpp/unit/test_order_group.cpp):
+tasks started in arbitrary order must execute in schedule order, and the
+recorded arrival order must reflect the actual start() order.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kungfu_tpu.ffi import OrderGroup
+
+
+def test_executes_in_schedule_order_despite_reversed_arrival():
+    names = [f"grad:{i}" for i in range(8)]
+    g = OrderGroup(names)
+    ran = []
+    for name in reversed(names):
+        g.start(name, lambda n=name: ran.append(n))
+    arrival = g.wait()
+    assert ran == names  # schedule order
+    assert arrival == list(reversed(names))  # true arrival order
+    g.close()
+
+
+def test_concurrent_starts_from_threads():
+    names = [f"t{i}" for i in range(16)]
+    g = OrderGroup(names)
+    ran = []
+    lock = threading.Lock()
+
+    def start_one(name):
+        time.sleep(0.001 * (hash(name) % 7))
+        g.start(name, lambda: (lock.acquire(), ran.append(name),
+                               lock.release()))
+
+    threads = [threading.Thread(target=start_one, args=(n,)) for n in names]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    arrival = g.wait()
+    assert ran == names
+    assert sorted(arrival) == sorted(names)
+    g.close()
+
+
+def test_multiple_cycles_reuse():
+    names = ["a", "b", "c"]
+    g = OrderGroup(names)
+    for _ in range(5):
+        ran = []
+        for n in ["c", "a", "b"]:
+            g.start(n, lambda n=n: ran.append(n))
+        arrival = g.wait()
+        assert ran == names
+        assert arrival == ["c", "a", "b"]
+    g.close()
+
+
+def test_duplicate_start_rejected():
+    g = OrderGroup(["x", "y"])
+    g.start("x", lambda: None)
+    with pytest.raises(Exception):
+        g.start("x", lambda: None)
+    g.start("y", lambda: None)
+    g.wait()
+    g.close()
+
+
+def test_unknown_name_rejected():
+    g = OrderGroup(["x"])
+    with pytest.raises(KeyError):
+        g.start("nope", lambda: None)
+    g.start("x", lambda: None)
+    g.wait()
+    g.close()
+
+
+def test_close_releases_blocked_waiter():
+    # a thread stuck in wait() on an incomplete cycle must be released
+    # (with an error) when the group is torn down, not hang forever
+    g = OrderGroup(["a", "b"])
+    g.start("b", lambda: None)  # "a" never arrives
+    result = {}
+
+    def waiter():
+        try:
+            result["order"] = g.wait()
+        except Exception as e:
+            result["error"] = e
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    g.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "wait() hung across close()"
+    assert "error" in result or result.get("order") is not None
+
+
+def test_teardown_with_partial_cycle_does_not_hang():
+    g = OrderGroup(["a", "b"])
+    g.start("b", lambda: None)  # "a" never arrives
+    t0 = time.time()
+    g.close()
+    assert time.time() - t0 < 5.0
+
+
+def test_custom_exec_order_via_c_api():
+    """A permuted schedule (position -> rank) runs tasks in that order."""
+    import ctypes
+
+    from kungfu_tpu.ffi import TASK_CB, load
+
+    lib = load()
+    order = (ctypes.c_int * 3)(2, 0, 1)  # run rank2 first, then 0, then 1
+    h = lib.kf_order_group_new(3, order)
+    assert h
+    ran = []
+    cbs = [TASK_CB(lambda _u, r=r: ran.append(r)) for r in range(3)]
+    for r in range(3):
+        assert lib.kf_order_group_start(h, r, cbs[r], None) == 0
+    out = (ctypes.c_int * 3)()
+    assert lib.kf_order_group_wait(h, out) == 0
+    assert ran == [2, 0, 1]
+    assert list(out) == [0, 1, 2]  # arrival order was 0,1,2
+    lib.kf_order_group_free(h)

@@ -1,0 +1,60 @@
+"""Platform launcher: TPU pod env -> kfrun argv (reference:
+srcs/go/plan/platforms/modelarts parsing tests analog)."""
+
+import pytest
+
+from kungfu_tpu.run.platforms import PodSpec, detect_tpu_pod, kfrun_args
+
+
+def test_detect_none_without_env():
+    assert detect_tpu_pod({}) is None
+
+
+def test_detect_pod():
+    pod = detect_tpu_pod({
+        "TPU_WORKER_HOSTNAMES": "t1k-0, t1k-1 ,t1k-2,t1k-3",
+        "TPU_WORKER_ID": "2",
+        "TPU_ACCELERATOR_TYPE": "v4-32",
+    })
+    assert pod.hosts == ["t1k-0", "t1k-1", "t1k-2", "t1k-3"]
+    assert pod.self_index == 2
+    assert pod.slots_per_host == 4
+    assert pod.total_slots == 16
+
+
+def test_slots_override():
+    pod = detect_tpu_pod({
+        "TPU_WORKER_HOSTNAMES": "a,b",
+        "KF_SLOTS_PER_HOST": "8",
+    })
+    assert pod.slots_per_host == 8
+    assert pod.total_slots == 16
+
+
+def test_worker_id_out_of_range():
+    with pytest.raises(ValueError):
+        detect_tpu_pod({
+            "TPU_WORKER_HOSTNAMES": "a,b",
+            "TPU_WORKER_ID": "5",
+        })
+
+
+def test_kfrun_args_resolution():
+    pod = PodSpec(hosts=["tpu-a", "tpu-b"], self_index=1, slots_per_host=4)
+    fake_dns = {"tpu-a": "10.0.0.1", "tpu-b": "10.0.0.2"}
+    args = kfrun_args(pod, ["python", "train.py"],
+                      extra_flags=["-strategy", "RING"],
+                      resolve=lambda h: fake_dns.get(h, h))
+    assert args == [
+        "-np", "8",
+        "-H", "10.0.0.1:4,10.0.0.2:4",
+        "-self", "10.0.0.2",
+        "-strategy", "RING",
+        "--", "python", "train.py",
+    ]
+
+
+def test_kfrun_args_literal_ips():
+    pod = PodSpec(hosts=["127.0.0.1"], self_index=0, slots_per_host=2)
+    args = kfrun_args(pod, ["prog"])
+    assert args[:4] == ["-np", "2", "-H", "127.0.0.1:2"]

@@ -1,0 +1,21 @@
+"""Stand-in ssh for launcher tests: runs the remote command locally.
+
+Usage (as kfdistribute's -ssh override): fake_ssh.py <dest> <command>.
+Exports KF_SSH_DEST so test programs can branch per-"host", mirroring how
+the reference's remote-runner tests avoid needing real machines.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def main() -> int:
+    dest = sys.argv[1]
+    command = sys.argv[2]
+    env = dict(os.environ, KF_SSH_DEST=dest)
+    return subprocess.call(["sh", "-c", command], env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
