@@ -227,8 +227,17 @@ class OrderGroup:
         if self._h is None:
             raise RuntimeError("order group is closed")
         out = (ctypes.c_int * len(self._names))()
-        _check(self._lib.kf_order_group_wait(self._h, out),
-               "order_group wait")
+        rc = self._lib.kf_order_group_wait(self._h, out)
+        if rc < 0:
+            # A failed wait means this thread did NOT consume the cycle: a
+            # concurrent winner did (and owns the cycle's callbacks and
+            # errors), or the group is tearing down (close() drops the
+            # leftovers). Touching shared state here would steal the NEXT
+            # cycle's live callbacks out from under the C executor.
+            _check(rc, "order_group wait")
+        # Winning waiter: consume exactly this cycle's callbacks + errors,
+        # so stale callbacks never accumulate and a prior cycle's task
+        # errors are never misattributed to a later wait().
         with self._mu:
             del self._cbs[:len(self._names)]
             errors, self._errors = self._errors, []
@@ -240,8 +249,14 @@ class OrderGroup:
 
     def close(self):
         if getattr(self, "_h", None):
-            self._lib.kf_order_group_free(self._h)
+            self._lib.kf_order_group_free(self._h)  # joins the executor
             self._h = None
+            # Safe only after free: no C thread can still hold the
+            # trampolines. Dropping them here keeps an abandoned cycle
+            # (teardown with wait() never called / failed) from leaking.
+            with self._mu:
+                self._cbs.clear()
+                self._errors.clear()
 
     def __del__(self):
         try:

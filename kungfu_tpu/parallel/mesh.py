@@ -29,40 +29,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 def data_mesh(
     num_devices: Optional[int] = None,
     axis_name: str = "data",
-    allow_host_fallback: bool = False,
+    devices=None,
 ) -> Mesh:
     """A 1-D mesh over the first `num_devices` visible devices.
 
     On a TPU pod slice, call after `jax.distributed.initialize()` (kfrun
-    does this) so `jax.devices()` spans all hosts. With
-    `allow_host_fallback` (dry runs only), too few accelerator devices
-    falls back to virtual CPU devices — the CPU backend honors
-    `--xla_force_host_platform_device_count` even when a TPU plugin owns
-    the default platform. Production callers keep the hard error so a
-    misconfigured pod fails fast instead of silently training on host CPU.
+    does this) so `jax.devices()` spans all hosts. Pass `devices`
+    explicitly to pin the mesh to a specific backend (the multi-chip dry
+    run pins virtual CPU devices this way so it never executes on whatever
+    platform owns the default backend). Without `devices` a short visible
+    set is a hard error, so a misconfigured pod fails fast instead of
+    silently training on host CPU.
     """
-    devices = jax.devices()
+    devices = list(devices) if devices is not None else jax.devices()
     if num_devices is not None:
         if num_devices > len(devices):
-            cpu = jax.devices("cpu") if allow_host_fallback else []
-            if num_devices > len(cpu):
-                hint = (
-                    f" and {len(cpu)} cpu — the CPU backend may already "
-                    "have initialized; run in a fresh process or set XLA_"
-                    f"FLAGS=--xla_force_host_platform_device_count="
-                    f"{num_devices}" if allow_host_fallback else ""
-                )
-                raise ValueError(
-                    f"requested {num_devices} devices, have {len(devices)} "
-                    f"({devices[0].platform}){hint}"
-                )
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "data_mesh: falling back to %d virtual CPU devices",
-                num_devices,
-            )
-            devices = cpu
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)} "
+                f"({devices[0].platform})")
         devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (axis_name,))
 

@@ -133,7 +133,7 @@ def main(argv=None) -> int:
     ap.add_argument("-logdir", default=".kfdistribute-logs")
     ap.add_argument("-q", dest="quiet", action="store_true")
     ap.add_argument("-timeout", type=float, default=None,
-                    help="per-host wall-clock limit, seconds")
+                    help="total wall-clock limit for the whole run, seconds")
     ap.add_argument("prog", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 

@@ -12,6 +12,7 @@ so one command line works unchanged on every host of a pod:
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import sys
@@ -83,8 +84,14 @@ def detect_tpu_pod(environ: Optional[Dict[str, str]] = None) -> Optional[
     if env.get("KF_SLOTS_PER_HOST"):
         slots = int(env["KF_SLOTS_PER_HOST"])
     else:
-        slots = _slots_from_accelerator(
-            env.get("TPU_ACCELERATOR_TYPE", ""), len(hosts)) or 4
+        acc = env.get("TPU_ACCELERATOR_TYPE", "")
+        slots = _slots_from_accelerator(acc, len(hosts))
+        if not slots:
+            slots = 4
+            logging.getLogger(__name__).warning(
+                "unrecognized TPU_ACCELERATOR_TYPE=%r; assuming %d "
+                "slots/host (set KF_SLOTS_PER_HOST to override)",
+                acc, slots)
     return PodSpec(hosts=hosts, self_index=self_index, slots_per_host=slots)
 
 

@@ -10,8 +10,30 @@ native build is a plain Makefile since libkf has no external deps).
 
 import subprocess
 
-from setuptools import Command, find_packages, setup
+from setuptools import Command, Distribution, find_packages, setup
 from setuptools.command.build_py import build_py
+
+
+class BinaryDistribution(Distribution):
+    """The wheel ships a platform-specific libkf.so, so it must carry a
+    platform tag rather than py3-none-any. libkf is ctypes-loaded (no
+    CPython ABI dependency), so the interpreter tag stays py3 — see the
+    bdist_wheel get_tag override below."""
+
+    def has_ext_modules(self):
+        return True
+
+
+try:
+    from wheel.bdist_wheel import bdist_wheel
+
+    class PlatWheel(bdist_wheel):
+        def get_tag(self):
+            _, _, plat = super().get_tag()
+            return "py3", "none", plat
+
+except ImportError:  # wheel not installed; sdist-only builds don't need it
+    PlatWheel = None
 
 
 class BuildNative(Command):
@@ -50,7 +72,12 @@ setup(
     },
     python_requires=">=3.9",
     install_requires=["numpy", "jax", "flax", "optax"],
-    cmdclass={"build_native": BuildNative, "build_py": BuildPyWithNative},
+    distclass=BinaryDistribution,
+    cmdclass={
+        "build_native": BuildNative,
+        "build_py": BuildPyWithNative,
+        **({"bdist_wheel": PlatWheel} if PlatWheel else {}),
+    },
     entry_points={
         "console_scripts": [
             "kfrun = kungfu_tpu.run.__main__:main",

@@ -7,6 +7,8 @@ params), proving architecture parity without copying size tables.
 
 import importlib.util
 import os
+import subprocess
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -115,3 +117,22 @@ class TestGraftEntry:
     def test_dryrun_multichip(self):
         m = self.load()
         m.dryrun_multichip(4)  # full SyncSGD step on a 4-device mesh
+
+    def test_dryrun_multichip_nondefault_cpu(self):
+        """Regression for round 1's red MULTICHIP check: the dry run must
+        stay green when a non-CPU platform owns the default backend (the
+        bench host's TPU had a broken libtpu; any array placed on it
+        crashed). Run in a subprocess with the conftest's JAX_PLATFORMS=cpu
+        pin removed, so whatever accelerator plugin this machine registers
+        (axon TPU on the bench host) becomes the default platform — the
+        exact driver environment."""
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__ as g; g.dryrun_multichip(4); "
+             "print('DRYRUN_GREEN')"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "DRYRUN_GREEN" in proc.stdout
