@@ -1,0 +1,86 @@
+"""Microbenchmark: SIMD vs portable reduce-kernel throughput.
+
+Times ``kf_accumulate`` (the kernel every DCN collective accumulates
+received chunks with) on both dispatch paths across buffer sizes.
+Mirrors the role of the reference's f16 benchmark (reference:
+srcs/go/kungfu/base/f16.c + op.cpp kernels, exercised by
+kungfu-bench-allreduce).
+
+Run:  python -m kungfu_tpu.benchmarks.reduce_kernels [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import ml_dtypes
+import numpy as np
+
+from kungfu_tpu import ffi
+
+DTYPES = [
+    ("f16", np.float16),
+    ("bf16", ml_dtypes.bfloat16),
+    ("f32", np.float32),
+    ("f64", np.float64),
+]
+
+
+def _time_one(dst, src, *, force_scalar: bool, min_time_s: float = 0.2):
+    """Best-of-batches GB/s for one accumulate configuration."""
+    ffi.accumulate(dst, src, "sum", force_scalar=force_scalar)  # warm up
+    nbytes = dst.nbytes * 2  # read src + read/write dst, count r+w once
+    iters = max(1, int(2e7 // max(dst.nbytes, 1)))
+    best = 0.0
+    t_end = time.perf_counter() + min_time_s
+    while time.perf_counter() < t_end:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ffi.accumulate(dst, src, "sum", force_scalar=force_scalar)
+        dt = (time.perf_counter() - t0) / iters
+        best = max(best, nbytes / dt / 1e9)
+    return best
+
+
+def run(sizes=(1 << 12, 1 << 16, 1 << 20, 1 << 24)):
+    rng = np.random.default_rng(0)
+    rows = []
+    for name, dtype in DTYPES:
+        for nbytes in sizes:
+            n = nbytes // np.dtype(dtype).itemsize
+            src = rng.standard_normal(n).astype(dtype)
+            dst = rng.standard_normal(n).astype(dtype)
+            scalar = _time_one(dst.copy(), src, force_scalar=True)
+            simd = _time_one(dst.copy(), src, force_scalar=False)
+            rows.append({
+                "dtype": name,
+                "bytes": nbytes,
+                "scalar_gbps": round(scalar, 2),
+                "simd_gbps": round(simd, 2),
+                "speedup": round(simd / scalar, 2),
+            })
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON object instead of a table")
+    args = ap.parse_args()
+    rows = run()
+    if args.json:
+        print(json.dumps({"simd_enabled": ffi.simd_enabled(np.float32),
+                          "rows": rows}))
+        return
+    print(f"simd dispatch active: {ffi.simd_enabled(np.float32)}")
+    print(f"{'dtype':>6} {'size':>10} {'scalar GB/s':>12} "
+          f"{'simd GB/s':>10} {'speedup':>8}")
+    for r in rows:
+        print(f"{r['dtype']:>6} {r['bytes']:>10} {r['scalar_gbps']:>12} "
+              f"{r['simd_gbps']:>10} {r['speedup']:>8}")
+
+
+if __name__ == "__main__":
+    main()

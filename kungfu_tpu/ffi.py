@@ -60,10 +60,18 @@ _NP_DTYPE_CODES = {
     np.dtype(np.uint64): 6,
     np.dtype(np.int64): 7,
     np.dtype(np.float16): 8,
-    # bf16 (code 9) has no numpy dtype; pass uint16 views with dtype_code=9
+    # bf16 (code 9) is registered below via ml_dtypes when available;
+    # otherwise pass uint16 views with dtype_code=9
     np.dtype(np.float32): 10,
     np.dtype(np.float64): 11,
 }
+
+try:
+    import ml_dtypes as _ml_dtypes
+
+    _NP_DTYPE_CODES[np.dtype(_ml_dtypes.bfloat16)] = 9
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    pass
 
 _OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
 
@@ -131,6 +139,9 @@ def load() -> ctypes.CDLL:
         "kf_stats": ([P, ctypes.POINTER(ctypes.c_uint64),
                       ctypes.POINTER(ctypes.c_uint64)], None),
         "kf_version_string": ([], cs),
+        "kf_accumulate": ([P, P, i64, ctypes.c_int, ctypes.c_int,
+                           ctypes.c_int], ctypes.c_int),
+        "kf_simd_enabled": ([ctypes.c_int], ctypes.c_int),
         "kf_order_group_new": ([ctypes.c_int, ctypes.POINTER(ctypes.c_int)],
                                P),
         "kf_order_group_start": ([P, ctypes.c_int, TASK_CB, P], ctypes.c_int),
@@ -162,6 +173,34 @@ def op_code(op: str) -> int:
 
 def _buf_ptr(a: np.ndarray) -> ctypes.c_void_p:
     return ctypes.c_void_p(a.ctypes.data)
+
+
+def accumulate(dst: np.ndarray, src: np.ndarray, op: str = "sum", *,
+               force_scalar: bool = False) -> None:
+    """In-place ``dst = dst (op) src`` via libkf's reduce kernel.
+
+    This is the accumulate step collectives run on received chunks,
+    SIMD-dispatched at runtime (AVX2/F16C with a portable fallback;
+    reference: srcs/go/kungfu/base/f16.c uses the same intrinsics).
+    ``force_scalar`` pins the portable path for comparison; both paths are
+    bit-identical.
+    """
+    lib = load()
+    if dst.shape != src.shape or dst.dtype != src.dtype:
+        raise ValueError("dst/src must match in shape and dtype")
+    if not dst.flags["C_CONTIGUOUS"] or not src.flags["C_CONTIGUOUS"]:
+        raise ValueError("buffers must be C-contiguous")
+    if not dst.flags.writeable:
+        raise ValueError("dst must be writeable")
+    _check(
+        lib.kf_accumulate(_buf_ptr(dst), _buf_ptr(src), dst.size,
+                          dtype_code(dst.dtype), op_code(op),
+                          1 if force_scalar else 0), "accumulate")
+
+
+def simd_enabled(dt) -> bool:
+    """True when this process reduces `dt` with vector kernels."""
+    return bool(load().kf_simd_enabled(dtype_code(np.dtype(dt))))
 
 
 class OrderGroup:

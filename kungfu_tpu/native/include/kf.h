@@ -153,6 +153,17 @@ void kf_order_group_free(kf_order_group *);
 int kf_ping(kf_peer *, int rank, int64_t *rtt_us); /* RTT to peer */
 void kf_stats(kf_peer *, uint64_t *egress_bytes, uint64_t *ingress_bytes);
 
+/* --- reduce kernels ------------------------------------------------------ */
+
+/* Elementwise dst[i] = dst[i] (op) src[i] on host buffers — the kernel the
+ * collectives accumulate with, exported for tests and microbenchmarks.
+ * force_scalar=1 bypasses the AVX2/F16C dispatch; both paths produce
+ * bit-identical results. Returns KF_OK / KF_ERR_ARG. */
+int kf_accumulate(void *dst, const void *src, int64_t count, int dtype,
+                  int op, int force_scalar);
+/* 1 if this process will use SIMD kernels for the given dtype, else 0. */
+int kf_simd_enabled(int dtype);
+
 /* library version string */
 const char *kf_version_string(void);
 

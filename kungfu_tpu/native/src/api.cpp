@@ -240,6 +240,27 @@ void kf_order_group_free(kf_order_group *g) {
     delete reinterpret_cast<OrderGroup *>(g);
 }
 
-const char *kf_version_string(void) { return "libkf 0.1.0 (kungfu-tpu)"; }
+int kf_accumulate(void *dst, const void *src, int64_t count, int dtype,
+                  int op, int force_scalar) {
+    if (!dst || !src || count < 0 || dtype < 0 || dtype > int(Dtype::f64) ||
+        op < 0 || op > int(ROp::prod))
+        return KF_ERR_ARG;
+    if (force_scalar)
+        reduce_accumulate_scalar(dst, src, count, Dtype(dtype), ROp(op));
+    else
+        reduce_accumulate(dst, src, count, Dtype(dtype), ROp(op));
+    return KF_OK;
+}
+
+int kf_simd_enabled(int dtype) {
+    if (dtype < 0 || dtype > int(Dtype::f64)) return 0;
+    // probe with a zero-length call: dispatch happens before the loop
+    uint8_t dummy[8] = {0};
+    return reduce_accumulate_simd(dummy, dummy, 0, Dtype(dtype), ROp::sum)
+               ? 1
+               : 0;
+}
+
+const char *kf_version_string(void) { return "libkf 0.1.1 (kungfu-tpu)"; }
 
 }  // extern "C"

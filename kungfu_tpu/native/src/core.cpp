@@ -5,6 +5,8 @@
 #include <ctime>
 #include <mutex>
 
+#include "halffloat.hpp"
+
 namespace kf {
 
 // ---------------------------------------------------------------- logging
@@ -58,66 +60,6 @@ size_t dtype_size(Dtype dt) {
 
 namespace {
 
-float f16_to_f32(uint16_t h) {
-    uint32_t sign = uint32_t(h & 0x8000) << 16;
-    uint32_t exp = (h >> 10) & 0x1F;
-    uint32_t man = h & 0x3FF;
-    uint32_t bits;
-    if (exp == 0) {
-        if (man == 0) {
-            bits = sign;
-        } else {  // subnormal: normalize
-            int shift = 0;
-            while (!(man & 0x400)) {
-                man <<= 1;
-                shift++;
-            }
-            man &= 0x3FF;
-            // subnormal value is man * 2^-24; after normalizing by `shift`
-            // the effective exponent is -15 - shift + 1 = -(14 + shift)
-            bits = sign | ((127 - 14 - shift) << 23) | (man << 13);
-        }
-    } else if (exp == 0x1F) {
-        bits = sign | 0x7F800000 | (man << 13);
-    } else {
-        bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
-    }
-    float f;
-    std::memcpy(&f, &bits, 4);
-    return f;
-}
-
-uint16_t f32_to_f16(float f) {
-    uint32_t bits;
-    std::memcpy(&bits, &f, 4);
-    uint16_t sign = uint16_t((bits >> 16) & 0x8000);
-    int32_t exp = int32_t((bits >> 23) & 0xFF) - 127 + 15;
-    uint32_t man = bits & 0x7FFFFF;
-    if (exp >= 0x1F) return sign | 0x7C00;  // inf/overflow
-    if (exp <= 0) {
-        if (exp < -10) return sign;  // underflow to zero
-        man |= 0x800000;
-        uint32_t shift = uint32_t(14 - exp);
-        return sign | uint16_t(man >> shift);
-    }
-    return sign | uint16_t(exp << 10) | uint16_t(man >> 13);
-}
-
-float bf16_to_f32(uint16_t h) {
-    uint32_t bits = uint32_t(h) << 16;
-    float f;
-    std::memcpy(&f, &bits, 4);
-    return f;
-}
-
-uint16_t f32_to_bf16(float f) {
-    uint32_t bits;
-    std::memcpy(&bits, &f, 4);
-    // round-to-nearest-even on the dropped 16 bits
-    uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
-    return uint16_t((bits + rounding) >> 16);
-}
-
 template <typename T>
 void accumulate_typed(T *dst, const T *src, int64_t n, ROp op) {
     switch (op) {
@@ -165,6 +107,12 @@ void accumulate_16bit_float(uint16_t *dst, const uint16_t *src, int64_t n,
 
 void reduce_accumulate(void *dst, const void *src, int64_t count, Dtype dt,
                        ROp op) {
+    if (reduce_accumulate_simd(dst, src, count, dt, op)) return;
+    reduce_accumulate_scalar(dst, src, count, dt, op);
+}
+
+void reduce_accumulate_scalar(void *dst, const void *src, int64_t count,
+                              Dtype dt, ROp op) {
     switch (dt) {
         case Dtype::u8:
             return accumulate_typed((uint8_t *)dst, (const uint8_t *)src,

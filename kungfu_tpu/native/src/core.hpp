@@ -44,9 +44,18 @@ enum class ROp : int { sum = 0, min = 1, max = 2, prod = 3 };
 
 size_t dtype_size(Dtype dt);
 
-// dst[i] = dst[i] (op) src[i]; f16/bf16 accumulate in f32.
+// dst[i] = dst[i] (op) src[i]; f16/bf16 accumulate in f32. Dispatches to
+// AVX2/F16C kernels when the CPU supports them (KF_NO_SIMD=1 forces the
+// portable path); SIMD and portable results are bit-identical.
 void reduce_accumulate(void *dst, const void *src, int64_t count, Dtype dt,
                        ROp op);
+// Portable scalar path, exported so tests/microbenchmarks can compare.
+void reduce_accumulate_scalar(void *dst, const void *src, int64_t count,
+                              Dtype dt, ROp op);
+// True when an AVX2/F16C kernel handled the call; false = caller must run
+// the portable loop (non-x86 builds always return false).
+bool reduce_accumulate_simd(void *dst, const void *src, int64_t count,
+                            Dtype dt, ROp op);
 
 // ------------------------------------------------------------------ peers
 

@@ -1,0 +1,115 @@
+"""SIMD reduce-kernel dispatch: correctness vs the portable path.
+
+The reference vectorizes f16 reduction with AVX/F16C intrinsics
+(reference: srcs/go/kungfu/base/f16.c:17-50); libkf adds bf16 (the native
+TPU dtype) and f32/f64 AVX2 kernels with runtime dispatch. These tests
+assert the two paths are bit-identical over random data for every
+(dtype, op) pair, which is the property that makes the dispatch safe: a
+heterogeneous cluster where some hosts lack AVX2 still all-reduces to the
+same bytes on every rank.
+"""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from kungfu_tpu import ffi
+
+FLOAT_DTYPES = [np.float16, ml_dtypes.bfloat16, np.float32, np.float64]
+INT_DTYPES = [np.uint8, np.int16, np.int32, np.int64]
+OPS = ["sum", "min", "max", "prod"]
+
+
+def _rand(dtype, n, seed):
+    rng = np.random.default_rng(seed)
+    if np.dtype(dtype).kind in "ui":
+        info = np.iinfo(dtype)
+        return rng.integers(info.min, min(info.max, 7), size=n).astype(dtype)
+    return rng.standard_normal(n).astype(dtype)
+
+
+@pytest.mark.parametrize("op", OPS)
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES, ids=str)
+def test_simd_matches_scalar_bitwise(dtype, op):
+    # odd length exercises the vector body and the scalar tail
+    n = 10007
+    a = _rand(dtype, n, 1)
+    b = _rand(dtype, n, 2)
+    fast, slow = a.copy(), a.copy()
+    ffi.accumulate(fast, b, op)
+    ffi.accumulate(slow, b, op, force_scalar=True)
+    assert np.array_equal(fast.view(np.uint8), slow.view(np.uint8))
+
+
+@pytest.mark.parametrize("dtype", INT_DTYPES, ids=str)
+def test_integer_dtypes_accumulate(dtype):
+    a = _rand(dtype, 257, 3)
+    b = _rand(dtype, 257, 4)
+    got = a.copy()
+    ffi.accumulate(got, b, "sum")
+    assert np.array_equal(got, (a + b).astype(dtype))
+
+
+def test_f16_sum_values():
+    # 67x-over-scalar fast path must still be *correct* halves
+    a = np.array([1.0, 2.5, -3.0, 0.0] * 64, np.float16)
+    b = np.array([0.5, 0.25, 1.0, -7.0] * 64, np.float16)
+    got = a.copy()
+    ffi.accumulate(got, b, "sum")
+    assert np.array_equal(got, (a.astype(np.float32)
+                                + b.astype(np.float32)).astype(np.float16))
+
+
+def test_bf16_dtype_registered():
+    # ml_dtypes bf16 arrays map to wire code 9 without manual viewing
+    assert ffi.dtype_code(np.dtype(ml_dtypes.bfloat16)) == 9
+
+
+def test_simd_enabled_reports():
+    # on x86 CI hosts with AVX2 this is True; the assertion is only that
+    # the probe is callable and stable
+    assert ffi.simd_enabled(np.float32) in (True, False)
+    assert ffi.simd_enabled(np.float32) == ffi.simd_enabled(np.float32)
+
+
+def test_accumulate_validates_args():
+    a = np.zeros(4, np.float32)
+    b = np.zeros(5, np.float32)
+    with pytest.raises(ValueError):
+        ffi.accumulate(a, b)
+    with pytest.raises(ValueError):
+        ffi.accumulate(a, a.astype(np.float64))
+    ro = np.zeros(4, np.float32)
+    ro.flags.writeable = False
+    with pytest.raises(ValueError):
+        ffi.accumulate(ro, np.zeros(4, np.float32))
+
+
+@pytest.mark.parametrize("op", ["min", "max"])
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES, ids=str)
+def test_minmax_special_values_bitwise(dtype, op):
+    # ±0 ties and NaN lanes must select identically on both paths: the
+    # scalar kernel keeps dst on ties/unordered, and the vector kernels
+    # pass (src, dst) to VMIN/VMAXP* to reproduce exactly that
+    vals = [0.0, -0.0, float("nan"), 1.0, -1.0, float("inf"),
+            float("-inf")]
+    n = len(vals) ** 2
+    a = np.array([x for x in vals for _ in vals] * 3, dtype)[:n * 3]
+    b = np.array([y for _ in vals for y in vals] * 3, dtype)[:n * 3]
+    fast, slow = a.copy(), a.copy()
+    ffi.accumulate(fast, b, op)
+    ffi.accumulate(slow, b, op, force_scalar=True)
+    assert np.array_equal(fast.view(np.uint8), slow.view(np.uint8))
+
+
+@pytest.mark.parametrize("dtype", [np.float16, ml_dtypes.bfloat16], ids=str)
+def test_nan_survives_sum(dtype):
+    # a NaN entering a reduce must come out NaN on both paths (the bf16
+    # bias-round narrowing would otherwise wrap large-payload NaNs to ±0)
+    a = np.full(64, np.float32(float("nan"))).astype(dtype)
+    b = np.ones(64, dtype)
+    for force_scalar in (False, True):
+        d = a.copy()
+        ffi.accumulate(d, b, "sum", force_scalar=force_scalar)
+        assert np.all(np.isnan(d.astype(np.float32))), force_scalar
