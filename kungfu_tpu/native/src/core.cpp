@@ -255,6 +255,108 @@ Graph reduce_graph_of(const Graph &bcast) {
     return g;
 }
 
+Strategy resolve_auto(Strategy s, const std::vector<PeerID> &peers) {
+    if (s != Strategy::auto_select) return s;
+    std::vector<int> masters;
+    std::unordered_map<uint32_t, int> host_master;
+    local_masters(peers, &masters, &host_master);
+    return masters.size() <= 1 ? Strategy::star : Strategy::binary_tree_star;
+}
+
+namespace {
+
+// local_masters with `root` forced to be its host's master, so host-aware
+// rooted graphs converge at the requested root. masters[0] == root.
+void rooted_masters(const std::vector<PeerID> &peers, int root,
+                    std::vector<int> *masters,
+                    std::unordered_map<uint32_t, int> *host_master) {
+    (*host_master)[peers[root].ipv4] = root;
+    masters->push_back(root);
+    for (int r = 0; r < int(peers.size()); r++) {
+        if (!host_master->count(peers[r].ipv4)) {
+            (*host_master)[peers[r].ipv4] = r;
+            masters->push_back(r);
+        }
+    }
+}
+
+// Binary tree over `order` (order[0] stays the root; the rest rotated by
+// `variant`), emitting edges into g.
+void binary_tree_over(Graph *g, const std::vector<int> &order, int variant) {
+    const int k = int(order.size());
+    if (k <= 1) return;
+    auto at = [&](int pos) {
+        if (pos == 0) return order[0];
+        return order[1 + (pos - 1 + variant) % (k - 1)];
+    };
+    for (int i = 0; i < k; i++)
+        for (int j : {2 * i + 1, 2 * i + 2})
+            if (j < k) g->add_edge(at(i), at(j));
+}
+
+}  // namespace
+
+int rooted_variants(Strategy s, const std::vector<PeerID> &peers) {
+    const int k = int(peers.size());
+    s = resolve_auto(s, peers);
+    switch (s) {
+        case Strategy::binary_tree:
+            return std::max(1, k - 1);
+        case Strategy::binary_tree_star:
+        case Strategy::multi_binary_tree_star: {
+            std::vector<int> masters;
+            std::unordered_map<uint32_t, int> host_master;
+            local_masters(peers, &masters, &host_master);
+            return std::max(1, int(masters.size()) - 1);
+        }
+        default:
+            return 1;  // star/clique/ring have one rooted shape
+    }
+}
+
+GraphPair rooted_pair(Strategy s, const std::vector<PeerID> &peers, int root,
+                      int variant) {
+    const int k = int(peers.size());
+    s = resolve_auto(s, peers);
+    if (s == Strategy::ring && k > 1) {
+        // chain ending (reduce) / starting (bcast) at root
+        return circular_pair(k, root);
+    }
+    Graph bcast(k);
+    switch (s) {
+        case Strategy::binary_tree: {
+            std::vector<int> order;
+            order.push_back(root);
+            for (int r = 0; r < k; r++)
+                if (r != root) order.push_back(r);
+            binary_tree_over(&bcast, order, variant);
+            break;
+        }
+        case Strategy::tree:
+        case Strategy::binary_tree_star:
+        case Strategy::multi_binary_tree_star: {
+            std::vector<int> masters;
+            std::unordered_map<uint32_t, int> host_master;
+            rooted_masters(peers, root, &masters, &host_master);
+            for (int r = 0; r < k; r++) {
+                int m = host_master[peers[r].ipv4];
+                if (m != r) bcast.add_edge(m, r);
+            }
+            if (s == Strategy::tree) {
+                for (size_t i = 1; i < masters.size(); i++)
+                    bcast.add_edge(masters[0], masters[i]);
+            } else {
+                binary_tree_over(&bcast, masters, variant);
+            }
+            break;
+        }
+        default:  // star, clique
+            bcast = star_graph(k, root);
+            break;
+    }
+    return {reduce_graph_of(bcast), bcast};
+}
+
 std::vector<GraphPair> build_strategy(Strategy s,
                                       const std::vector<PeerID> &peers) {
     const int k = int(peers.size());
@@ -262,8 +364,7 @@ std::vector<GraphPair> build_strategy(Strategy s,
     std::unordered_map<uint32_t, int> host_master;
     local_masters(peers, &masters, &host_master);
 
-    if (s == Strategy::auto_select)
-        s = masters.size() <= 1 ? Strategy::star : Strategy::binary_tree_star;
+    s = resolve_auto(s, peers);
 
     std::vector<GraphPair> out;
     auto from_bcast = [&](const Graph &b) {

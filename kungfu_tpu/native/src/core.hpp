@@ -124,6 +124,16 @@ enum class Strategy : int {
 using GraphPair = std::pair<Graph, Graph>;
 std::vector<GraphPair> build_strategy(Strategy s,
                                       const std::vector<PeerID> &peers);
+// AUTO -> concrete strategy for this peer list (star on one host,
+// binary-tree-star across hosts), identity otherwise.
+Strategy resolve_auto(Strategy s, const std::vector<PeerID> &peers);
+// Rooted collectives (explicit-root reduce/broadcast): a (reduce, bcast)
+// pair of strategy `s` whose graphs converge at / fan out from `root`.
+// `variant` (0 <= variant < rooted_variants) rotates the non-root interior
+// so chunked transfers spread fan-out load across different trees.
+int rooted_variants(Strategy s, const std::vector<PeerID> &peers);
+GraphPair rooted_pair(Strategy s, const std::vector<PeerID> &peers, int root,
+                      int variant);
 // Star bcast graph rooted at r (for explicit-root broadcast/reduce).
 Graph star_graph(int k, int r);
 Graph reduce_graph_of(const Graph &bcast);

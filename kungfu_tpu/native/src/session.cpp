@@ -42,7 +42,51 @@ Session::Session(PeerID self, std::vector<PeerID> peers, Strategy strategy,
             local_size_++;
         }
     }
-    strategies_ = build_strategy(strategy, peers_);
+    strategy_ = resolve_auto(strategy, peers_);
+    strategies_ = build_strategy(strategy_, peers_);
+}
+
+std::vector<GraphPair> Session::rooted_pairs(int root) const {
+    const int nv = rooted_variants(strategy_, peers_);
+    std::vector<GraphPair> pairs;
+    pairs.reserve(size_t(nv));
+    for (int v = 0; v < nv; v++)
+        pairs.push_back(rooted_pair(strategy_, peers_, root, v));
+    return pairs;
+}
+
+int Session::for_chunks(
+    int64_t total_bytes, size_t esz, const std::string &name,
+    const std::function<int(int64_t, int64_t, const std::string &, uint64_t)>
+        &fn) {
+    const int64_t elems_per_chunk =
+        std::max<int64_t>(1, kChunkBytes / int64_t(esz));
+    const int64_t bytes_per_chunk = elems_per_chunk * int64_t(esz);
+    const int64_t n_chunks =
+        std::max<int64_t>(1, (total_bytes + bytes_per_chunk - 1) /
+                                 bytes_per_chunk);
+    auto run_chunk = [&](int64_t ci) -> int {
+        const int64_t lo = ci * bytes_per_chunk;
+        const int64_t n = std::min(bytes_per_chunk, total_bytes - lo);
+        const std::string chunk_name =
+            n_chunks == 1
+                ? name
+                : name + "[" + std::to_string(lo / int64_t(esz)) + "]";
+        return fn(lo, n, chunk_name, fnv1a(chunk_name));
+    };
+    if (n_chunks == 1) return run_chunk(0);
+    std::vector<int> rcs(size_t(n_chunks), KF_OK);
+    for (int64_t base = 0; base < n_chunks; base += kMaxChunkThreads) {
+        const int64_t hi =
+            std::min<int64_t>(base + kMaxChunkThreads, n_chunks);
+        std::vector<std::thread> ts;
+        for (int64_t ci = base; ci < hi; ci++)
+            ts.emplace_back([&, ci] { rcs[size_t(ci)] = run_chunk(ci); });
+        for (auto &t : ts) t.join();
+    }
+    for (int rc : rcs)
+        if (rc != KF_OK) return rc;
+    return KF_OK;
 }
 
 int Session::send_chunk(int dst_rank, const std::string &name,
@@ -55,24 +99,31 @@ int Session::run_graphs(uint8_t *chunk, int64_t nbytes, Dtype dt, ROp op,
                         const Graph &rg, const Graph &bg,
                         const std::string &name) {
     const int64_t count = nbytes / int64_t(dtype_size(dt));
-    std::vector<uint8_t> incoming;
-    // reduce phase: accumulate children, then forward partial to parent
-    for (int prev : rg.prev[rank_]) {
-        int rc = rdv_->pop(peers_[prev], name, &incoming, timeout_ms_);
-        if (rc != KF_OK) return rc;
-        if (int64_t(incoming.size()) != nbytes) return KF_ERR;
-        reduce_accumulate(chunk, incoming.data(), count, dt, op);
+    // reduce phase: accumulate children (received in-place into a pooled
+    // scratch by the socket reader), then forward partial to parent
+    if (!rg.prev[rank_].empty()) {
+        PooledBuf incoming{size_t(nbytes)};
+        for (int prev : rg.prev[rank_]) {
+            size_t len = 0;
+            int rc = rdv_->pop_into(peers_[prev], name, incoming.data(),
+                                    size_t(nbytes), &len, timeout_ms_);
+            if (rc != KF_OK) return rc;
+            if (int64_t(len) != nbytes) return KF_ERR;
+            reduce_accumulate(chunk, incoming.data(), count, dt, op);
+        }
     }
     for (int next : rg.next[rank_]) {
         int rc = send_chunk(next, name, chunk, nbytes);
         if (rc != KF_OK) return rc;
     }
-    // broadcast phase: adopt the finished value, then fan out
+    // broadcast phase: the finished value lands directly in `chunk`
+    // (zero-copy registered receive), then fan out
     for (int prev : bg.prev[rank_]) {
-        int rc = rdv_->pop(peers_[prev], name, &incoming, timeout_ms_);
+        size_t len = 0;
+        int rc = rdv_->pop_into(peers_[prev], name, chunk, size_t(nbytes),
+                                &len, timeout_ms_);
         if (rc != KF_OK) return rc;
-        if (int64_t(incoming.size()) != nbytes) return KF_ERR;
-        std::memcpy(chunk, incoming.data(), size_t(nbytes));
+        if (int64_t(len) != nbytes) return KF_ERR;
     }
     for (int next : bg.next[rank_]) {
         int rc = send_chunk(next, name, chunk, nbytes);
@@ -87,100 +138,105 @@ int Session::all_reduce(const void *send, void *recv, int64_t count, Dtype dt,
     const int64_t nbytes = count * int64_t(esz);
     if (recv != send) std::memcpy(recv, send, size_t(nbytes));
     if (peers_.size() <= 1) return KF_OK;
-
-    // split into ~1MiB chunks aligned to element size; each chunk picks a
-    // strategy pair by stable name hash so multi-graph strategies (ring,
-    // clique, multi-tree) spread chunks across roots
-    const int64_t elems_per_chunk =
-        std::max<int64_t>(1, kChunkBytes / int64_t(esz));
-    const int64_t n_chunks = (count + elems_per_chunk - 1) / elems_per_chunk;
-    auto run_chunk = [&](int64_t ci) -> int {
-        const int64_t lo = ci * elems_per_chunk;
-        const int64_t n = std::min(elems_per_chunk, count - lo);
-        const std::string chunk_name =
-            n_chunks == 1 ? name
-                          : name + "[" + std::to_string(lo) + "]";
-        const auto &pair =
-            strategies_[fnv1a(chunk_name) % strategies_.size()];
-        return run_graphs((uint8_t *)recv + lo * int64_t(esz),
-                          n * int64_t(esz), dt, op, pair.first, pair.second,
-                          chunk_name);
-    };
-    if (n_chunks == 1) return run_chunk(0);
-
-    std::vector<int> rcs(size_t(n_chunks), KF_OK);
-    for (int64_t base = 0; base < n_chunks; base += kMaxChunkThreads) {
-        const int64_t hi = std::min<int64_t>(base + kMaxChunkThreads, n_chunks);
-        std::vector<std::thread> ts;
-        for (int64_t ci = base; ci < hi; ci++)
-            ts.emplace_back([&, ci] { rcs[size_t(ci)] = run_chunk(ci); });
-        for (auto &t : ts) t.join();
-    }
-    for (int rc : rcs)
-        if (rc != KF_OK) return rc;
-    return KF_OK;
+    // each ~1MiB chunk picks a strategy pair by stable name hash so
+    // multi-graph strategies (ring, clique, multi-tree) spread chunks
+    // across roots
+    return for_chunks(
+        nbytes, esz, name,
+        [&](int64_t lo, int64_t n, const std::string &cname, uint64_t hash) {
+            const auto &pair = strategies_[hash % strategies_.size()];
+            return run_graphs((uint8_t *)recv + lo, n, dt, op, pair.first,
+                              pair.second, cname);
+        });
 }
 
 int Session::reduce(const void *send, void *recv, int64_t count, Dtype dt,
                     ROp op, int root, const std::string &name) {
-    const int64_t nbytes = count * int64_t(dtype_size(dt));
+    if (root < 0 || root >= size()) return KF_ERR_ARG;
+    const size_t esz = dtype_size(dt);
+    const int64_t nbytes = count * int64_t(esz);
     if (recv != send && rank_ == root)
         std::memcpy(recv, send, size_t(nbytes));
     if (peers_.size() <= 1) return KF_OK;
-    // star reduce into root; non-roots only need a scratch copy to send
-    std::vector<uint8_t> scratch;
-    uint8_t *buf;
-    if (rank_ == root) {
-        buf = (uint8_t *)recv;
-    } else {
-        scratch.assign((const uint8_t *)send, (const uint8_t *)send + nbytes);
-        buf = scratch.data();
-    }
-    Graph bcast = star_graph(size(), root);
-    Graph rg = reduce_graph_of(bcast);
+    // chunked walk of the configured strategy's reduce graphs re-rooted at
+    // `root`; non-roots accumulate in a pooled scratch copy of their chunk
+    const auto pairs = rooted_pairs(root);
     Graph no_bcast(size());
-    return run_graphs(buf, nbytes, dt, op, rg, no_bcast, name);
+    return for_chunks(
+        nbytes, esz, name,
+        [&](int64_t lo, int64_t n, const std::string &cname, uint64_t hash) {
+            const auto &rg = pairs[hash % pairs.size()].first;
+            if (rank_ == root)
+                return run_graphs((uint8_t *)recv + lo, n, dt, op, rg,
+                                  no_bcast, cname);
+            PooledBuf scratch{size_t(n)};
+            std::memcpy(scratch.data(), (const uint8_t *)send + lo,
+                        size_t(n));
+            return run_graphs(scratch.data(), n, dt, op, rg, no_bcast,
+                              cname);
+        });
 }
 
 int Session::broadcast(const void *send, void *recv, int64_t count, Dtype dt,
                        int root, const std::string &name) {
-    const int64_t nbytes = count * int64_t(dtype_size(dt));
+    if (root < 0 || root >= size()) return KF_ERR_ARG;
+    const size_t esz = dtype_size(dt);
+    const int64_t nbytes = count * int64_t(esz);
     if (recv != send && rank_ == root)
         std::memcpy(recv, send, size_t(nbytes));
     if (peers_.size() <= 1) {
         if (recv != send) std::memcpy(recv, send, size_t(nbytes));
         return KF_OK;
     }
-    // binary tree over root-rotated rank order
-    const int k = size();
-    Graph bcast(k);
-    auto at = [&](int pos) { return (pos + root) % k; };
-    for (int i = 0; i < k; i++)
-        for (int j : {2 * i + 1, 2 * i + 2})
-            if (j < k) bcast.add_edge(at(i), at(j));
-    Graph no_reduce(k);
-    return run_graphs((uint8_t *)recv, nbytes, dt, ROp::sum, no_reduce, bcast,
-                      name);
+    // chunked walk of the configured strategy's bcast graphs re-rooted at
+    // `root`; chunk spreading rotates the tree interior so no single relay
+    // carries the whole model (elastic joiner resync rides this path)
+    const auto pairs = rooted_pairs(root);
+    Graph no_reduce(size());
+    return for_chunks(
+        nbytes, esz, name,
+        [&](int64_t lo, int64_t n, const std::string &cname, uint64_t hash) {
+            const auto &bg = pairs[hash % pairs.size()].second;
+            return run_graphs((uint8_t *)recv + lo, n, dt, ROp::sum,
+                              no_reduce, bg, cname);
+        });
 }
 
 int Session::gather(const void *send, int64_t count, void *recv,
                     int64_t total_count, Dtype dt, int root,
                     const std::string &name) {
+    if (root < 0 || root >= size()) return KF_ERR_ARG;
     const size_t esz = dtype_size(dt);
     const int64_t nbytes = count * int64_t(esz);
-    if (rank_ != root)
-        return send_chunk(root, name, (const uint8_t *)send, nbytes);
+    if (rank_ != root) {
+        // chunked so a large shard streams instead of one monolithic
+        // message (reference routes everything through the chunk split,
+        // session.go:263-292)
+        return for_chunks(
+            nbytes, esz, name,
+            [&](int64_t lo, int64_t n, const std::string &cname, uint64_t) {
+                return send_chunk(root, cname, (const uint8_t *)send + lo,
+                                  n);
+            });
+    }
     if (total_count < count * int64_t(size())) return KF_ERR_ARG;
     std::memcpy((uint8_t *)recv + int64_t(rank_) * nbytes, send,
                 size_t(nbytes));
-    std::vector<uint8_t> incoming;
     for (int r = 0; r < size(); r++) {
         if (r == rank_) continue;
-        int rc = rdv_->pop(peers_[r], name, &incoming, timeout_ms_);
+        uint8_t *base = (uint8_t *)recv + int64_t(r) * nbytes;
+        // registered receive: each chunk lands in its recv slice in-place
+        int rc = for_chunks(
+            nbytes, esz, name,
+            [&](int64_t lo, int64_t n, const std::string &cname,
+                uint64_t) -> int {
+                size_t len = 0;
+                int prc = rdv_->pop_into(peers_[r], cname, base + lo,
+                                         size_t(n), &len, timeout_ms_);
+                if (prc != KF_OK) return prc;
+                return int64_t(len) == n ? KF_OK : KF_ERR;
+            });
         if (rc != KF_OK) return rc;
-        if (int64_t(incoming.size()) != nbytes) return KF_ERR;
-        std::memcpy((uint8_t *)recv + int64_t(r) * nbytes, incoming.data(),
-                    size_t(nbytes));
     }
     return KF_OK;
 }
@@ -192,21 +248,32 @@ int Session::all_gather(const void *send, int64_t count, void *recv, Dtype dt,
     std::memcpy((uint8_t *)recv + int64_t(rank_) * nbytes, send,
                 size_t(nbytes));
     if (peers_.size() <= 1) return KF_OK;
-    // direct mesh: everyone sends its shard to everyone (reference:
-    // srcs/go/kungfu/session/allgather.go)
+    // direct mesh, chunked: everyone streams its shard to everyone
+    // (reference: srcs/go/kungfu/session/allgather.go), receives land
+    // in-place in the recv slice
     for (int r = 0; r < size(); r++) {
         if (r == rank_) continue;
-        int rc = send_chunk(r, name, (const uint8_t *)send, nbytes);
+        int rc = for_chunks(
+            nbytes, esz, name,
+            [&](int64_t lo, int64_t n, const std::string &cname, uint64_t) {
+                return send_chunk(r, cname, (const uint8_t *)send + lo, n);
+            });
         if (rc != KF_OK) return rc;
     }
-    std::vector<uint8_t> incoming;
     for (int r = 0; r < size(); r++) {
         if (r == rank_) continue;
-        int rc = rdv_->pop(peers_[r], name, &incoming, timeout_ms_);
+        uint8_t *base = (uint8_t *)recv + int64_t(r) * nbytes;
+        int rc = for_chunks(
+            nbytes, esz, name,
+            [&](int64_t lo, int64_t n, const std::string &cname,
+                uint64_t) -> int {
+                size_t len = 0;
+                int prc = rdv_->pop_into(peers_[r], cname, base + lo,
+                                         size_t(n), &len, timeout_ms_);
+                if (prc != KF_OK) return prc;
+                return int64_t(len) == n ? KF_OK : KF_ERR;
+            });
         if (rc != KF_OK) return rc;
-        if (int64_t(incoming.size()) != nbytes) return KF_ERR;
-        std::memcpy((uint8_t *)recv + int64_t(r) * nbytes, incoming.data(),
-                    size_t(nbytes));
     }
     return KF_OK;
 }

@@ -2,6 +2,7 @@
 // (Control-plane rebuild of reference srcs/go/kungfu/session.)
 #pragma once
 
+#include <functional>
 #include <string>
 #include <vector>
 
@@ -42,10 +43,22 @@ class Session {
                    const Graph &rg, const Graph &bg, const std::string &name);
     int send_chunk(int dst_rank, const std::string &name, const uint8_t *data,
                    int64_t nbytes);
+    // Split [0, total_bytes) into ~1MiB element-aligned chunks and run
+    // fn(lo_bytes, n_bytes, chunk_name, name_hash) across the chunk thread
+    // pool; every collective routes through this (reference:
+    // session.go:263-292 runStrategies chunk split).
+    int for_chunks(int64_t total_bytes, size_t esz, const std::string &name,
+                   const std::function<int(int64_t, int64_t,
+                                           const std::string &, uint64_t)>
+                       &fn);
+    // Rooted (reduce, bcast) pairs of the configured strategy for explicit-
+    // root collectives; one per interior variant for chunk spreading.
+    std::vector<GraphPair> rooted_pairs(int root) const;
 
     PeerID self_;
     std::vector<PeerID> peers_;
     int rank_ = -1, local_rank_ = 0, local_size_ = 1;
+    Strategy strategy_ = Strategy::star;  // post-AUTO-resolution
     std::vector<GraphPair> strategies_;
     Client *client_;
     Rendezvous *rdv_;

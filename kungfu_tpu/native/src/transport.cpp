@@ -4,9 +4,12 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/un.h>
+#include <poll.h>
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdio>
 #include <cstring>
 
 #include "../include/kf.h"
@@ -35,7 +38,66 @@ int64_t now_us() {
         .count();
 }
 
+bool unix_sockets_disabled() {
+    return std::getenv("KF_NO_UNIX_SOCKET") != nullptr;
+}
+
+int ceil_log2(size_t n) {
+    int b = 0;
+    while ((size_t(1) << b) < n) b++;
+    return b;
+}
+
 }  // namespace
+
+std::string sock_path(const PeerID &p) {
+    char buf[108];
+    std::snprintf(buf, sizeof(buf), "/tmp/kf-u%u-%08x-%u.sock",
+                  unsigned(::getuid()), p.ipv4, unsigned(p.port));
+    return buf;
+}
+
+// ------------------------------------------------------------ buffer pool
+
+BufferPool &BufferPool::instance() {
+    static BufferPool pool;
+    return pool;
+}
+
+std::vector<uint8_t> BufferPool::get(size_t n) {
+    const int b = ceil_log2(n ? n : 1);
+    if (b < kBuckets) {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto &q = buckets_[b];
+        if (!q.empty()) {
+            std::vector<uint8_t> v = std::move(q.back());
+            q.pop_back();
+            cached_ -= v.capacity();
+            v.resize(n);  // within capacity: no realloc
+            return v;
+        }
+    }
+    std::vector<uint8_t> v;
+    v.reserve(size_t(1) << b);
+    v.resize(n);
+    return v;
+}
+
+void BufferPool::put(std::vector<uint8_t> &&v) {
+    const size_t cap = v.capacity();
+    if (cap == 0 || (cap & (cap - 1)) != 0) return;  // only pow-2 capacities
+    const int b = ceil_log2(cap);
+    if (b >= kBuckets) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    if (cached_ + cap > kMaxCachedBytes) return;  // over cap: let it free
+    cached_ += cap;
+    buckets_[b].push_back(std::move(v));
+}
+
+size_t BufferPool::cached_bytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return cached_;
+}
 
 // ------------------------------------------------------------------- fd io
 
@@ -51,6 +113,40 @@ bool read_exact(int fd, void *buf, size_t n) {
         n -= size_t(r);
     }
     return true;
+}
+
+// Like read_exact but fails if the fd makes no progress for stall_ms
+// (message *bodies* must stream continuously once the header arrived; a
+// mid-body stall means a dead/partitioned sender and must not hold a
+// registered receive past its failure-detection deadline). stall_ms <= 0
+// waits indefinitely. Header reads keep plain read_exact: an idle
+// connection between collectives is legitimate.
+bool read_exact_progress(int fd, void *buf, size_t n, int64_t stall_ms) {
+    auto *p = static_cast<uint8_t *>(buf);
+    while (n > 0) {
+        if (stall_ms > 0) {
+            pollfd pfd{fd, POLLIN, 0};
+            int pr = ::poll(&pfd, 1, int(stall_ms));
+            if (pr < 0 && errno == EINTR) continue;
+            if (pr <= 0) return false;  // no progress within stall_ms
+        }
+        ssize_t r = ::read(fd, p, n);
+        if (r <= 0) {
+            if (r < 0 && errno == EINTR) continue;
+            return false;
+        }
+        p += r;
+        n -= size_t(r);
+    }
+    return true;
+}
+
+int64_t body_stall_ms() {
+    static const int64_t v = [] {
+        const char *s = std::getenv("KF_BODY_STALL_MS");
+        return s ? std::atoll(s) : 60000;
+    }();
+    return v;
 }
 
 bool write_exact(int fd, const void *buf, size_t n) {
@@ -101,9 +197,131 @@ bool read_message(int fd, WireMessage *out, size_t max_len) {
 // ------------------------------------------------------------- rendezvous
 
 void Rendezvous::push(const PeerID &src, WireMessage msg) {
+    const std::string key = rdv_key(src, msg.name);
     std::lock_guard<std::mutex> lk(mu_);
-    q_[rdv_key(src, msg.name)].push_back(std::move(msg.data));
+    // a receiver may have registered between this message's header read
+    // (which chose the queue path) and now — deliver into its slot here or
+    // it would wait forever watching a slot no reader will ever claim
+    auto qit = q_.find(key);
+    const bool queue_empty = qit == q_.end() || qit->second.empty();
+    auto sit = slots_.find(key);
+    if (queue_empty && sit != slots_.end() && !sit->second.empty()) {
+        RecvSlot *slot = sit->second.front();
+        sit->second.pop_front();
+        if (sit->second.empty()) slots_.erase(sit);
+        if (slot->cap >= msg.data.size()) {
+            std::memcpy(slot->buf, msg.data.data(), msg.data.size());
+            slot->len = msg.data.size();
+            slot->state = RecvSlot::done;
+            BufferPool::instance().put(std::move(msg.data));
+            cv_.notify_all();
+            return;
+        }
+        slot->state = RecvSlot::failed;  // undersized registration
+    }
+    q_[key].push_back(std::move(msg.data));
     cv_.notify_all();
+}
+
+Rendezvous::RecvSlot *Rendezvous::begin_recv(const PeerID &src,
+                                             const std::string &name,
+                                             size_t len) {
+    const std::string key = rdv_key(src, name);
+    std::lock_guard<std::mutex> lk(mu_);
+    auto qit = q_.find(key);
+    if (qit != q_.end() && !qit->second.empty())
+        return nullptr;  // FIFO: queued messages drain before slots fill
+    auto sit = slots_.find(key);
+    if (sit == slots_.end() || sit->second.empty()) return nullptr;
+    RecvSlot *slot = sit->second.front();
+    if (slot->cap < len) {
+        // undersized registration: fail it; message falls back to the queue
+        sit->second.pop_front();
+        if (sit->second.empty()) slots_.erase(sit);
+        slot->state = RecvSlot::failed;
+        cv_.notify_all();
+        return nullptr;
+    }
+    sit->second.pop_front();
+    if (sit->second.empty()) slots_.erase(sit);
+    slot->len = len;
+    slot->state = RecvSlot::claimed;
+    return slot;
+}
+
+void Rendezvous::commit_recv(RecvSlot *slot, bool ok) {
+    std::lock_guard<std::mutex> lk(mu_);
+    slot->state = ok ? RecvSlot::done : RecvSlot::failed;
+    cv_.notify_all();
+}
+
+int Rendezvous::pop_into(const PeerID &src, const std::string &name,
+                         void *buf, size_t cap, size_t *len,
+                         int64_t timeout_ms) {
+    const std::string key = rdv_key(src, name);
+    const bool stall_log = std::getenv("KF_STALL_DETECTION") != nullptr;
+    const auto t0 = std::chrono::steady_clock::now();
+    const auto deadline = t0 + std::chrono::milliseconds(timeout_ms);
+    auto next_stall_report = t0 + std::chrono::seconds(3);
+    RecvSlot slot;
+    slot.buf = static_cast<uint8_t *>(buf);
+    slot.cap = cap;
+    bool registered = false;
+    std::unique_lock<std::mutex> lk(mu_);
+    {
+        auto it = q_.find(key);
+        if (it != q_.end() && !it->second.empty()) {
+            std::vector<uint8_t> msg = std::move(it->second.front());
+            it->second.pop_front();
+            if (it->second.empty()) q_.erase(it);
+            if (msg.size() > cap) return KF_ERR;
+            std::memcpy(buf, msg.data(), msg.size());
+            if (len) *len = msg.size();
+            BufferPool::instance().put(std::move(msg));
+            return KF_OK;
+        }
+        slots_[key].push_back(&slot);
+        registered = true;
+    }
+    for (;;) {
+        if (slot.state == RecvSlot::done) {
+            if (len) *len = slot.len;
+            return KF_OK;
+        }
+        if (slot.state == RecvSlot::failed) return KF_ERR_CONN;
+        const auto now = std::chrono::steady_clock::now();
+        // a claimed slot is being written by the reader thread: the buffer
+        // is in use, so the timeout must wait for the commit
+        if (slot.state == RecvSlot::waiting && timeout_ms > 0 &&
+            now >= deadline) {
+            if (registered) {
+                auto sit = slots_.find(key);
+                if (sit != slots_.end()) {
+                    auto &dq = sit->second;
+                    for (auto i = dq.begin(); i != dq.end(); ++i) {
+                        if (*i == &slot) {
+                            dq.erase(i);
+                            break;
+                        }
+                    }
+                    if (dq.empty()) slots_.erase(sit);
+                }
+            }
+            return KF_ERR_TIMEOUT;
+        }
+        if (stall_log && now >= next_stall_report) {
+            KF_WARN("recv-into of %s stalled for %lds", key.c_str(),
+                    long(std::chrono::duration_cast<std::chrono::seconds>(
+                             now - t0)
+                             .count()));
+            next_stall_report = now + std::chrono::seconds(3);
+        }
+        auto wake = now + std::chrono::seconds(3);  // stall-report tick
+        if (timeout_ms > 0 && deadline < wake &&
+            slot.state == RecvSlot::waiting)
+            wake = deadline;
+        cv_.wait_until(lk, wake);
+    }
 }
 
 int Rendezvous::pop(const PeerID &src, const std::string &name,
@@ -140,6 +358,14 @@ int Rendezvous::pop(const PeerID &src, const std::string &name,
 void Rendezvous::clear() {
     std::lock_guard<std::mutex> lk(mu_);
     q_.clear();
+    // fail every waiting registration so blocked receivers fail fast at an
+    // epoch switch instead of timing out; claimed slots are mid-write and
+    // resolve via the reader's commit_recv
+    for (auto &kv : slots_)
+        for (RecvSlot *s : kv.second)
+            if (s->state == RecvSlot::waiting) s->state = RecvSlot::failed;
+    slots_.clear();
+    cv_.notify_all();
 }
 
 // ------------------------------------------------------------------ store
@@ -204,7 +430,21 @@ Client::~Client() {
 
 void Client::set_token(uint32_t token) { token_ = token; }
 
-int Client::dial(const PeerID &dest, ConnType t) {
+int Client::dial_fd(const PeerID &dest) {
+    // colocated peers (same IPv4) talk over a Unix socket, skipping the TCP
+    // stack (reference: connection.go:60-64 dials SockFile when src/dst
+    // share an IP); fall back to TCP if the socket file isn't there yet
+    if (dest.colocated_with(self_) && !unix_sockets_disabled()) {
+        int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+        if (fd >= 0) {
+            sockaddr_un ua{};
+            ua.sun_family = AF_UNIX;
+            const std::string path = sock_path(dest);
+            std::strncpy(ua.sun_path, path.c_str(), sizeof(ua.sun_path) - 1);
+            if (::connect(fd, (sockaddr *)&ua, sizeof(ua)) == 0) return fd;
+            ::close(fd);
+        }
+    }
     int fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return KF_ERR_CONN;
     int one = 1;
@@ -217,6 +457,12 @@ int Client::dial(const PeerID &dest, ConnType t) {
         ::close(fd);
         return KF_ERR_CONN;
     }
+    return fd;
+}
+
+int Client::dial(const PeerID &dest, ConnType t) {
+    int fd = dial_fd(dest);
+    if (fd < 0) return fd;
     ConnHeader h{uint16_t(t), self_.port, self_.ipv4};
     Ack ack{};
     if (!write_exact(fd, &h, sizeof(h)) || !read_exact(fd, &ack, sizeof(ack))) {
@@ -367,8 +613,29 @@ int Server::start() {
         listen_fd_ = -1;
         return KF_ERR;
     }
+    if (!unix_sockets_disabled()) {
+        unix_path_ = sock_path(self_);
+        ::unlink(unix_path_.c_str());  // stale socket from a dead process
+        unix_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+        if (unix_fd_ >= 0) {
+            sockaddr_un ua{};
+            ua.sun_family = AF_UNIX;
+            std::strncpy(ua.sun_path, unix_path_.c_str(),
+                         sizeof(ua.sun_path) - 1);
+            if (::bind(unix_fd_, (sockaddr *)&ua, sizeof(ua)) != 0 ||
+                ::listen(unix_fd_, 128) != 0) {
+                KF_WARN("unix bind/listen failed on %s: %s (TCP only)",
+                        unix_path_.c_str(), std::strerror(errno));
+                ::close(unix_fd_);
+                unix_fd_ = -1;
+            }
+        }
+    }
     running_ = true;
-    accept_thread_ = std::thread([this] { accept_loop(); });
+    accept_thread_ = std::thread([this] { accept_loop(listen_fd_, true); });
+    if (unix_fd_ >= 0)
+        unix_accept_thread_ =
+            std::thread([this] { accept_loop(unix_fd_, false); });
     return KF_OK;
 }
 
@@ -376,7 +643,14 @@ void Server::stop() {
     if (!running_.exchange(false)) return;
     ::shutdown(listen_fd_, SHUT_RDWR);
     ::close(listen_fd_);
+    if (unix_fd_ >= 0) {
+        ::shutdown(unix_fd_, SHUT_RDWR);
+        ::close(unix_fd_);
+        ::unlink(unix_path_.c_str());
+        unix_fd_ = -1;
+    }
     if (accept_thread_.joinable()) accept_thread_.join();
+    if (unix_accept_thread_.joinable()) unix_accept_thread_.join();
     // kick every reader out of its blocking read, then wait for the
     // (detached) connection threads to drain
     std::unique_lock<std::mutex> lk(mu_);
@@ -399,15 +673,17 @@ void Server::set_request_handler(RequestHandler h) {
     request_handler_ = std::move(h);
 }
 
-void Server::accept_loop() {
+void Server::accept_loop(int listen_fd, bool tcp) {
     while (running_) {
-        int fd = ::accept(listen_fd_, nullptr, nullptr);
+        int fd = ::accept(listen_fd, nullptr, nullptr);
         if (fd < 0) {
             if (running_) continue;
             break;
         }
-        int one = 1;
-        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        if (tcp) {
+            int one = 1;
+            ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        }
         {
             std::lock_guard<std::mutex> lk(mu_);
             live_fds_.insert(fd);
@@ -435,13 +711,45 @@ void Server::serve_conn(int fd) {
     if (!write_exact(fd, &ack, sizeof(ack))) return;
     const PeerID src{h.src_ipv4, h.src_port};
     const auto t = ConnType(h.type);
+    if (t == ConnType::collective) {
+        // collective fast path: after the header, ask the rendezvous for a
+        // registered buffer so the body lands in-place (zero-copy); else
+        // read into a pooled vector and queue it
+        while (running_) {
+            uint32_t name_len;
+            if (!read_exact(fd, &name_len, 4)) return;
+            if (name_len > 4096) return;
+            std::string name(name_len, '\0');
+            if (name_len && !read_exact(fd, name.data(), name_len)) return;
+            uint32_t flags, len;
+            if (!read_exact(fd, &flags, 4)) return;
+            if (!read_exact(fd, &len, 4)) return;
+            counters_->ingress += len;
+            const int64_t stall = body_stall_ms();
+            if (auto *slot = rdv_->begin_recv(src, name, len)) {
+                const bool ok =
+                    len == 0 ||
+                    read_exact_progress(fd, slot->buf, len, stall);
+                rdv_->commit_recv(slot, ok);
+                if (!ok) return;
+                continue;
+            }
+            WireMessage msg;
+            msg.name = std::move(name);
+            msg.flags = flags;
+            msg.data = BufferPool::instance().get(len);
+            if (len && !read_exact_progress(fd, msg.data.data(), len, stall))
+                return;
+            rdv_->push(src, std::move(msg));
+        }
+        return;
+    }
     WireMessage msg;
     while (running_ && read_message(fd, &msg)) {
         counters_->ingress += msg.data.size();
         switch (t) {
             case ConnType::collective:
-                rdv_->push(src, std::move(msg));
-                break;
+                return;  // unreachable: dedicated loop above handles these
             case ConnType::p2p: {
                 RequestHandler handler;
                 {

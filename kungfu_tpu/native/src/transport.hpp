@@ -45,6 +45,49 @@ struct WireMessage {
     std::vector<uint8_t> data;
 };
 
+// ------------------------------------------------------------- buffer pool
+
+// Power-of-2 free-list pool for receive buffers (reference:
+// srcs/go/rchannel/connection/byte_slice_pool.go keeps per-size-class
+// sync.Pools behind recvQ). Vectors handed out have size()==n and a pow-2
+// capacity; put() recycles them up to a global cap so steady-state
+// collective traffic stops allocating.
+class BufferPool {
+  public:
+    static BufferPool &instance();
+    std::vector<uint8_t> get(size_t n);
+    void put(std::vector<uint8_t> &&v);
+    // bytes currently cached (for tests/metrics)
+    size_t cached_bytes();
+
+  private:
+    static constexpr int kBuckets = 33;  // capacities 2^0 .. 2^32
+    static constexpr size_t kMaxCachedBytes = size_t(1) << 28;  // 256 MiB
+    std::mutex mu_;
+    std::deque<std::vector<uint8_t>> buckets_[kBuckets];
+    size_t cached_ = 0;
+};
+
+// RAII pooled buffer: releases back to the pool on scope exit.
+class PooledBuf {
+  public:
+    explicit PooledBuf(size_t n) : v_(BufferPool::instance().get(n)) {}
+    ~PooledBuf() { BufferPool::instance().put(std::move(v_)); }
+    PooledBuf(const PooledBuf &) = delete;
+    PooledBuf &operator=(const PooledBuf &) = delete;
+    uint8_t *data() { return v_.data(); }
+    size_t size() const { return v_.size(); }
+
+  private:
+    std::vector<uint8_t> v_;
+};
+
+// Filesystem path of a peer's colocated-peer Unix socket. Derived from
+// (uid, ipv4, port) so parallel test clusters of different users cannot
+// collide (reference: plan/addr.go:50-59 SockFile). Colocated peers dial
+// this instead of TCP loopback; KF_NO_UNIX_SOCKET=1 disables.
+std::string sock_path(const PeerID &p);
+
 // ------------------------------------------------------------------- fd io
 
 // Blocking exact-length read/write on a socket fd; false on EOF/error.
@@ -62,16 +105,41 @@ bool read_message(int fd, WireMessage *out, size_t max_len = size_t(1) << 33);
 // makes reduce-phase and bcast-phase messages on the same name unambiguous.
 class Rendezvous {
   public:
+    // Registered in-place receive: the socket reader writes the message
+    // body straight into a slot's caller-owned buffer, skipping the queue
+    // allocation + copy (reference: WaitRecvBuf flag, message.go:70-75 +
+    // handler/collective.go:34-41 RecvInto).
+    struct RecvSlot {
+        uint8_t *buf = nullptr;
+        size_t cap = 0;
+        size_t len = 0;  // filled body length
+        enum { waiting, claimed, done, failed } state = waiting;
+    };
+
     void push(const PeerID &src, WireMessage msg);
     // Blocks until a message for (src,name) arrives; KF_OK / KF_ERR_TIMEOUT.
     int pop(const PeerID &src, const std::string &name,
             std::vector<uint8_t> *out, int64_t timeout_ms);
+    // In-place receive into caller memory. Takes an already-queued message
+    // if present (recycling its buffer), else registers `buf` so the reader
+    // thread fills it directly. Fails with KF_ERR if the message is larger
+    // than cap, KF_ERR_CONN if the connection died mid-body or clear() ran.
+    int pop_into(const PeerID &src, const std::string &name, void *buf,
+                 size_t cap, size_t *len, int64_t timeout_ms);
+    // Reader side: claim a waiting slot for (src,name) if one exists and
+    // the queue is empty (FIFO order); nullptr = read into a pooled vector
+    // and push(). A slot too small for `len` is failed and nullptr returned.
+    RecvSlot *begin_recv(const PeerID &src, const std::string &name,
+                         size_t len);
+    void commit_recv(RecvSlot *slot, bool ok);
+    // Drops queued messages and fails all waiting slots (epoch switch).
     void clear();
 
   private:
     std::mutex mu_;
     std::condition_variable cv_;
     std::unordered_map<std::string, std::deque<std::vector<uint8_t>>> q_;
+    std::unordered_map<std::string, std::deque<RecvSlot *>> slots_;
 };
 
 // ------------------------------------------------------------------ store
@@ -143,6 +211,7 @@ class Client {
     };
     std::shared_ptr<Conn> get(const PeerID &dest, ConnType t);
     int dial(const PeerID &dest, ConnType t);  // returns fd or negative err
+    int dial_fd(const PeerID &dest);           // raw connect, unix-or-tcp
     int ensure_connected(Conn *c, const PeerID &dest, ConnType t);
 
     PeerID self_;
@@ -180,7 +249,7 @@ class Server {
     void set_request_handler(RequestHandler h);
 
   private:
-    void accept_loop();
+    void accept_loop(int listen_fd, bool tcp);
     void serve_conn(int fd);
 
     PeerID self_;
@@ -189,7 +258,10 @@ class Server {
     std::atomic<uint32_t> token_{0};
     std::atomic<bool> running_{false};
     int listen_fd_ = -1;
+    int unix_fd_ = -1;  // colocated-peer listener (AF_UNIX)
+    std::string unix_path_;
     std::thread accept_thread_;
+    std::thread unix_accept_thread_;
     std::mutex mu_;
     std::condition_variable conns_done_cv_;
     int active_conns_ = 0;

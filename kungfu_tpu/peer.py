@@ -175,6 +175,18 @@ class Peer:
             return x[None, ...].copy()
         return self._native.all_gather(x, name=name)
 
+    def reduce(self, x, op="sum", root=0, name=""):
+        """Reduce to `root`; returns the result there, None elsewhere."""
+        if self._native is None:
+            return x.copy()
+        return self._native.reduce(x, op=op, root=root, name=name)
+
+    def gather(self, x, root=0, name=""):
+        """Gather shards to `root`; stacked array there, None elsewhere."""
+        if self._native is None:
+            return x[None, ...].copy()
+        return self._native.gather(x, root=root, name=name)
+
     def consensus(self, data: bytes, name: str = "consensus") -> bool:
         return True if self._native is None else self._native.consensus(
             data, name=name)

@@ -1,0 +1,99 @@
+"""Scratch 3: dump optimized HLO of the bf16-BN step and histogram bytes."""
+import re
+import sys
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from scratch_profile2 import ResNetBF
+from kungfu_tpu.models.resnet import BottleneckBlock
+from kungfu_tpu.optimizers import sync_sgd
+from kungfu_tpu.parallel import (
+    build_train_step_with_state,
+    data_mesh,
+    init_worker_state,
+    replicate_to_workers,
+    shard_batch,
+)
+
+DT_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
+            "s8": 1, "u8": 1, "f64": 8, "s64": 8, "u64": 8, "u16": 2,
+            "s16": 2}
+
+
+def shape_bytes(stext):
+    """bytes of one shape like f32[1,128,56,56]{...} (no tuples)."""
+    m = re.match(r"(\w+)\[([\d,]*)\]", stext)
+    if not m:
+        return 0
+    dt, dims = m.groups()
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * DT_BYTES.get(dt, 4)
+
+
+def main():
+    n = jax.device_count()
+    mesh = data_mesh(n)
+    b = 128
+    model = ResNetBF(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
+                     num_classes=1000, dtype=jnp.bfloat16)
+    x = jnp.ones((b * n, 224, 224, 3), jnp.float32)
+    y = jnp.zeros((b * n,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
+
+    def loss_fn(params, batch_stats, batch):
+        logits, updated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["x"], train=True, mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+        return loss, updated["batch_stats"]
+
+    tx = sync_sgd(optax.sgd(0.1, momentum=0.9))
+    params = replicate_to_workers(variables["params"], mesh)
+    stats = replicate_to_workers(variables["batch_stats"], mesh)
+    opt = init_worker_state(tx, params, mesh)
+    batch_s = shard_batch({"x": x, "y": y}, mesh)
+    step = build_train_step_with_state(loss_fn, tx, mesh)
+    compiled = step.lower(params, stats, opt, batch_s).compile()
+    txt = compiled.as_text()
+    with open("/tmp/step_hlo.txt", "w") as f:
+        f.write(txt)
+    print(f"HLO dumped: {len(txt)} chars", flush=True)
+
+    # histogram output bytes by opcode for top-level ops (rough HBM proxy)
+    by_op = defaultdict(lambda: [0, 0])
+    # match lines like:  %name = f32[1,2](...) opcode(
+    pat = re.compile(r"=\s+((?:\w+\[[\d,]*\][^ ]*|\([^)]*\)))\s+(\w+)")
+    for line in txt.splitlines():
+        m = pat.search(line)
+        if not m:
+            continue
+        stext, op = m.groups()
+        if stext.startswith("("):
+            bts = sum(shape_bytes(s) for s in
+                      re.findall(r"\w+\[[\d,]*\]", stext))
+        else:
+            bts = shape_bytes(stext)
+        by_op[op][0] += bts
+        by_op[op][1] += 1
+    total = sum(v[0] for v in by_op.values())
+    print(f"total output bytes (all ops incl fused): {total/1e9:.2f} GB")
+    for op, (bts, cnt) in sorted(by_op.items(), key=lambda kv: -kv[1][0])[:18]:
+        print(f"  {op:30s} {bts/1e9:8.3f} GB  x{cnt}")
+
+    try:
+        ma = compiled.memory_analysis()
+        print("memory:", ma)
+    except Exception as e:
+        print("memory_analysis failed:", e)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+"""Scratch: ablation timings for ResNet-50 step on one TPU chip."""
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kungfu_tpu.models import ResNet50
+from kungfu_tpu.optimizers import sync_sgd
+from kungfu_tpu.parallel import (
+    build_train_step_with_state,
+    data_mesh,
+    init_worker_state,
+    replicate_to_workers,
+    shard_batch,
+)
+
+
+def timeit(fn, *args, iters=20, warmup=3):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[-1]
+    float(jnp.sum(leaf))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[-1]
+    float(jnp.sum(leaf))
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def timeit_step(step, params, stats, opt, batch, iters=20, warmup=3):
+    """Like timeit but threads outputs back as inputs (donation-safe)."""
+    for _ in range(warmup):
+        params, stats, opt, loss = step(params, stats, opt, batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, stats, opt, loss = step(params, stats, opt, batch)
+    float(loss)
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def main():
+    n = jax.device_count()
+    mesh = data_mesh(n)
+    b = 128
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    x = jnp.ones((b * n, 224, 224, 3), jnp.float32)
+    y = jnp.zeros((b * n,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
+
+    def loss_fn(params, batch_stats, batch):
+        logits, updated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["x"], train=True, mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+        return loss, updated["batch_stats"]
+
+    tx = sync_sgd(optax.sgd(0.1, momentum=0.9))
+    params_s = replicate_to_workers(variables["params"], mesh)
+    stats_s = replicate_to_workers(variables["batch_stats"], mesh)
+    opt_s = init_worker_state(tx, params_s, mesh)
+    batch_s = shard_batch({"x": x, "y": y}, mesh)
+
+    # 1. full step (the bench number)
+    step = build_train_step_with_state(loss_fn, tx, mesh)
+    t_full = timeit_step(step, params_s, stats_s, opt_s, batch_s)
+    print(f"full step:            {t_full:.2f} ms", flush=True)
+
+    # 2. forward only (inference mode, no BN stat update)
+    @jax.jit
+    def fwd(variables, x):
+        return model.apply(variables, x, train=False)
+
+    xb = x
+    t_fwd = timeit(fwd, variables, xb)
+    print(f"fwd only (eval):      {t_fwd:.2f} ms", flush=True)
+
+    # 3. fwd+bwd only, no optimizer / no pmean
+    @jax.jit
+    def fwdbwd(params, batch_stats, batch):
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, batch)
+        return loss, grads
+
+    batch_h = {"x": x, "y": y}
+    t_fb = timeit(fwdbwd, variables["params"], variables["batch_stats"],
+                  batch_h)
+    print(f"fwd+bwd (no opt):     {t_fb:.2f} ms", flush=True)
+
+    # 4. bf16 BatchNorm variant
+    import flax.linen as nn
+    from functools import partial as fp
+    from kungfu_tpu.models.resnet import ResNet, BottleneckBlock
+
+    class ResNetBF(ResNet):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            conv = fp(nn.Conv, use_bias=False, dtype=self.dtype,
+                      padding="SAME")
+            norm = fp(nn.BatchNorm, use_running_average=not train,
+                      momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                      param_dtype=jnp.float32, axis_name=None)
+            x = x.astype(self.dtype)
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            for i, block_count in enumerate(self.stage_sizes):
+                for j in range(block_count):
+                    strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                    x = self.block_cls(
+                        filters=self.num_filters * 2 ** i,
+                        strides=strides, conv=conv, norm=norm)(x)
+            x = jnp.mean(x, axis=(1, 2))
+            x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+            return x
+
+    model_bf = ResNetBF(stage_sizes=[3, 4, 6, 3],
+                        block_cls=BottleneckBlock, num_classes=1000,
+                        dtype=jnp.bfloat16)
+    vars_bf = model_bf.init(jax.random.PRNGKey(0), x[:2], train=True)
+
+    def loss_bf(params, batch_stats, batch):
+        logits, updated = model_bf.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["x"], train=True, mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+        return loss, updated["batch_stats"]
+
+    step_bf = build_train_step_with_state(loss_bf, tx, mesh)
+    pb = replicate_to_workers(vars_bf["params"], mesh)
+    sb = replicate_to_workers(vars_bf["batch_stats"], mesh)
+    ob = init_worker_state(tx, pb, mesh)
+    t_bf = timeit_step(step_bf, pb, sb, ob, batch_s)
+    print(f"full step (bf16 BN):  {t_bf:.2f} ms", flush=True)
+
+    imgs = b * n
+    for name, t in [("current", t_full), ("bf16-BN", t_bf)]:
+        gf = 12.3 * imgs  # ~12.3 GFLOPs/img fwd+bwd estimate
+        print(f"{name}: {imgs / (t / 1000):.0f} img/s, "
+              f"~{gf / t:.0f} GFLOP/s achieved")
+
+
+if __name__ == "__main__":
+    main()

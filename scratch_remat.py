@@ -1,0 +1,103 @@
+"""Scratch 4: remat variants of bf16-BN ResNet-50."""
+import time
+from functools import partial as fp
+
+import jax
+import jax.numpy as jnp
+import optax
+import flax.linen as nn
+
+from kungfu_tpu.models.resnet import ResNet, BottleneckBlock
+from kungfu_tpu.optimizers import sync_sgd
+from kungfu_tpu.parallel import (
+    build_train_step_with_state,
+    data_mesh,
+    init_worker_state,
+    replicate_to_workers,
+    shard_batch,
+)
+
+
+def make_model(remat_policy=None):
+    block = BottleneckBlock
+    if remat_policy is not None:
+        block = nn.remat(
+            BottleneckBlock,
+            policy=remat_policy,
+            prevent_cse=False,
+        )
+
+    class M(ResNet):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            conv = fp(nn.Conv, use_bias=False, dtype=self.dtype,
+                      padding="SAME")
+            norm = fp(nn.BatchNorm, use_running_average=not train,
+                      momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                      param_dtype=jnp.float32, axis_name=None)
+            x = x.astype(self.dtype)
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            for i, block_count in enumerate(self.stage_sizes):
+                for j in range(block_count):
+                    strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                    x = self.block_cls(
+                        filters=self.num_filters * 2 ** i,
+                        strides=strides, conv=conv, norm=norm)(x)
+            x = jnp.mean(x, axis=(1, 2))
+            x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+            return x
+
+    return M(stage_sizes=[3, 4, 6, 3], block_cls=block,
+             num_classes=1000, dtype=jnp.bfloat16)
+
+
+def run(name, model, b=128, bf16_input=False):
+    n = jax.device_count()
+    mesh = data_mesh(n)
+    xdt = jnp.bfloat16 if bf16_input else jnp.float32
+    x = jnp.ones((b * n, 224, 224, 3), xdt)
+    y = jnp.zeros((b * n,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
+
+    def loss_fn(params, batch_stats, batch):
+        logits, updated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["x"], train=True, mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+        return loss, updated["batch_stats"]
+
+    tx = sync_sgd(optax.sgd(0.1, momentum=0.9))
+    params = replicate_to_workers(variables["params"], mesh)
+    stats = replicate_to_workers(variables["batch_stats"], mesh)
+    opt = init_worker_state(tx, params, mesh)
+    batch_s = shard_batch({"x": x, "y": y}, mesh)
+    step = build_train_step_with_state(loss_fn, tx, mesh)
+    compiled = step.lower(params, stats, opt, batch_s).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    for _ in range(3):
+        params, stats, opt, loss = step(params, stats, opt, batch_s)
+    float(loss)
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, stats, opt, loss = step(params, stats, opt, batch_s)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:24s} {dt*1000:7.2f} ms  {b*n/dt:6.0f} img/s  "
+          f"flops={ca.get('flops',0)/1e9:.0f}GF "
+          f"bytes={ca.get('bytes accessed',0)/1e9:.1f}GB", flush=True)
+
+
+if __name__ == "__main__":
+    cp = jax.checkpoint_policies
+    run("no remat", make_model(None))
+    run("remat nothing_saveable", make_model(cp.nothing_saveable))
+    run("remat dots_saveable", make_model(cp.dots_saveable))
+    run("no remat bf16-in", make_model(None), bf16_input=True)

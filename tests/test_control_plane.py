@@ -268,6 +268,121 @@ class TestOtherCollectives:
         assert sum(s["ingress_bytes"] for s in stats) > 0
 
 
+STRATEGIES = ["STAR", "RING", "CLIQUE", "TREE", "BINARY_TREE",
+              "BINARY_TREE_STAR", "MULTI_BINARY_TREE_STAR"]
+
+
+class TestRootedChunkedCollectives:
+    """Explicit-root reduce/broadcast follow the configured strategy's
+    graphs (reference: session.go:142-150 uses strategies[0]'s graph pair)
+    and large buffers split into 1MiB chunks spread over rotated tree
+    interiors (reference: session.go:263-292 chunk split)."""
+
+    @pytest.mark.parametrize("strategy", STRATEGIES)
+    def test_large_broadcast_nonzero_root(self, strategy):
+        peers = make_cluster(4, strategy=strategy)
+        try:
+            n = (1 << 20) + 513  # >4MiB of f32: forces the chunked path
+            expected = np.arange(n, dtype=np.float32)
+
+            def work(p, rank):
+                x = (expected if rank == 2
+                     else np.zeros(n, dtype=np.float32))
+                return p.broadcast(x, root=2, name="bigbc")
+
+            for r in run_on_all(peers, work):
+                np.testing.assert_array_equal(r, expected)
+        finally:
+            shutdown(peers)
+
+    @pytest.mark.parametrize("strategy", STRATEGIES)
+    def test_large_reduce_nonzero_root(self, strategy):
+        peers = make_cluster(4, strategy=strategy)
+        try:
+            n = (1 << 20) + 257
+            def work(p, rank):
+                x = np.full(n, float(rank + 1), dtype=np.float32)
+                return p.reduce(x, root=3, name="bigred")
+
+            results = run_on_all(peers, work)
+            np.testing.assert_array_equal(
+                results[3], np.full(n, 10.0, dtype=np.float32))
+            assert results[0] is None
+        finally:
+            shutdown(peers)
+
+    def test_broadcast_chunks_spread_across_relays(self):
+        # with BINARY_TREE at np=4 every chunk's root fans out to two
+        # relay positions; the per-chunk interior rotation must give
+        # *different* ranks relay (egress) work — a monolithic or
+        # fixed-tree broadcast would leave exactly one non-root rank
+        # forwarding everything
+        peers = make_cluster(4, strategy="BINARY_TREE")
+        try:
+            n = (1 << 20) * 2  # 8MiB -> 8 chunks
+            def work(p, rank):
+                x = (np.ones(n, dtype=np.float32) if rank == 0
+                     else np.zeros(n, dtype=np.float32))
+                return p.broadcast(x, root=0, name="spread")
+
+            run_on_all(peers, work)
+            egress = [p.stats()["egress_bytes"] for p in peers]
+            relays = [r for r in range(1, 4) if egress[r] > 0]
+            assert len(relays) >= 2, f"chunk relays not spread: {egress}"
+        finally:
+            shutdown(peers)
+
+    def test_large_gather_and_all_gather(self):
+        peers = make_cluster(4)
+        try:
+            n = (1 << 20) // 2  # 2MiB shard each: chunked shard streaming
+            def work(p, rank):
+                x = np.full(n, float(rank), dtype=np.float32)
+                g = p.gather(x, root=1, name="bigg")
+                ag = p.all_gather(x, name="bigag")
+                return g, ag
+
+            results = run_on_all(peers, work)
+            expected = np.stack([np.full(n, float(r), dtype=np.float32)
+                                 for r in range(4)])
+            np.testing.assert_array_equal(results[1][0], expected)
+            assert results[0][0] is None
+            for _, ag in results:
+                np.testing.assert_array_equal(ag, expected)
+        finally:
+            shutdown(peers)
+
+
+class TestUnixSocketTransport:
+    def test_colocated_peers_create_and_use_unix_sockets(self):
+        import os
+        ports = alloc_ports(2)
+        spec = ",".join(f"127.0.0.1:{p}" for p in ports)
+        peers = [NativePeer(f"127.0.0.1:{p}", spec, version=0,
+                            strategy="AUTO", timeout_ms=20000)
+                 for p in ports]
+        for p in peers:
+            p.start()
+        # 127.0.0.1 == 0x7f000001 in the socket filename
+        socks = [f"/tmp/kf-u{os.getuid()}-7f000001-{p}.sock" for p in ports]
+        try:
+            for s in socks:
+                assert os.path.exists(s)  # one listener per colocated peer
+
+            def work(p, rank):
+                return p.all_reduce(np.full(8, float(rank + 1),
+                                            dtype=np.float32), name="ux")
+
+            for r in run_on_all(peers, work):
+                np.testing.assert_array_equal(
+                    r, np.full(8, 3.0, dtype=np.float32))
+        finally:
+            shutdown(peers)
+        # listeners unlink their socket files on stop
+        for s in socks:
+            assert not os.path.exists(s)
+
+
 class TestP2P:
     def setup_method(self, _):
         self.peers = make_cluster(3)
