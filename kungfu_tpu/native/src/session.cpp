@@ -46,13 +46,22 @@ Session::Session(PeerID self, std::vector<PeerID> peers, Strategy strategy,
     strategies_ = build_strategy(strategy_, peers_);
 }
 
-std::vector<GraphPair> Session::rooted_pairs(int root) const {
+std::shared_ptr<const std::vector<GraphPair>> Session::rooted_pairs(
+    int root) {
+    {
+        std::lock_guard<std::mutex> lk(rooted_mu_);
+        auto it = rooted_cache_.find(root);
+        if (it != rooted_cache_.end()) return it->second;
+    }
     const int nv = rooted_variants(strategy_, peers_);
-    std::vector<GraphPair> pairs;
-    pairs.reserve(size_t(nv));
+    auto pairs = std::make_shared<std::vector<GraphPair>>();
+    pairs->reserve(size_t(nv));
     for (int v = 0; v < nv; v++)
-        pairs.push_back(rooted_pair(strategy_, peers_, root, v));
-    return pairs;
+        pairs->push_back(rooted_pair(strategy_, peers_, root, v));
+    std::lock_guard<std::mutex> lk(rooted_mu_);
+    auto &entry = rooted_cache_[root];
+    if (!entry) entry = std::move(pairs);
+    return entry;
 }
 
 int Session::for_chunks(
@@ -165,7 +174,7 @@ int Session::reduce(const void *send, void *recv, int64_t count, Dtype dt,
     return for_chunks(
         nbytes, esz, name,
         [&](int64_t lo, int64_t n, const std::string &cname, uint64_t hash) {
-            const auto &rg = pairs[hash % pairs.size()].first;
+            const auto &rg = (*pairs)[hash % pairs->size()].first;
             if (rank_ == root)
                 return run_graphs((uint8_t *)recv + lo, n, dt, op, rg,
                                   no_bcast, cname);
@@ -196,7 +205,7 @@ int Session::broadcast(const void *send, void *recv, int64_t count, Dtype dt,
     return for_chunks(
         nbytes, esz, name,
         [&](int64_t lo, int64_t n, const std::string &cname, uint64_t hash) {
-            const auto &bg = pairs[hash % pairs.size()].second;
+            const auto &bg = (*pairs)[hash % pairs->size()].second;
             return run_graphs((uint8_t *)recv + lo, n, dt, ROp::sum,
                               no_reduce, bg, cname);
         });

@@ -3,6 +3,9 @@
 #pragma once
 
 #include <functional>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
 #include <string>
 #include <vector>
 
@@ -53,13 +56,17 @@ class Session {
                        &fn);
     // Rooted (reduce, bcast) pairs of the configured strategy for explicit-
     // root collectives; one per interior variant for chunk spreading.
-    std::vector<GraphPair> rooted_pairs(int root) const;
+    // Cached per root: graphs depend only on (strategy, peers, root).
+    std::shared_ptr<const std::vector<GraphPair>> rooted_pairs(int root);
 
     PeerID self_;
     std::vector<PeerID> peers_;
     int rank_ = -1, local_rank_ = 0, local_size_ = 1;
     Strategy strategy_ = Strategy::star;  // post-AUTO-resolution
     std::vector<GraphPair> strategies_;
+    std::mutex rooted_mu_;
+    std::unordered_map<int, std::shared_ptr<const std::vector<GraphPair>>>
+        rooted_cache_;
     Client *client_;
     Rendezvous *rdv_;
     int64_t timeout_ms_;

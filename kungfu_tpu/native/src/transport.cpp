@@ -4,6 +4,8 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
 #include <sys/un.h>
 #include <poll.h>
 #include <unistd.h>
@@ -48,13 +50,36 @@ int ceil_log2(size_t n) {
     return b;
 }
 
+// Unix sockets default to ~208KB buffers (vs TCP loopback's autotuned
+// MBs), which convoys concurrent chunk senders; ask for 4MiB each way
+// (the kernel clamps to wmem_max/rmem_max).
+void grow_unix_bufs(int fd) {
+    int sz = 4 << 20;
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+}
+
 }  // namespace
 
 std::string sock_path(const PeerID &p) {
+    // sockets live inside a per-uid 0700 directory so another local user
+    // can neither squat the path ahead of bind nor connect to it
     char buf[108];
-    std::snprintf(buf, sizeof(buf), "/tmp/kf-u%u-%08x-%u.sock",
+    std::snprintf(buf, sizeof(buf), "/tmp/kf-u%u/%08x-%u.sock",
                   unsigned(::getuid()), p.ipv4, unsigned(p.port));
     return buf;
+}
+
+// Create the per-uid socket directory; false (=> TCP fallback) unless it
+// ends up existing with mode 0700 and owned by us.
+bool ensure_sock_dir() {
+    char dir[64];
+    std::snprintf(dir, sizeof(dir), "/tmp/kf-u%u", unsigned(::getuid()));
+    if (::mkdir(dir, 0700) != 0 && errno != EEXIST) return false;
+    struct stat st{};
+    if (::lstat(dir, &st) != 0) return false;
+    return S_ISDIR(st.st_mode) && st.st_uid == ::getuid() &&
+           (st.st_mode & 0777) == 0700;
 }
 
 // ------------------------------------------------------------ buffer pool
@@ -205,19 +230,26 @@ void Rendezvous::push(const PeerID &src, WireMessage msg) {
     auto qit = q_.find(key);
     const bool queue_empty = qit == q_.end() || qit->second.empty();
     auto sit = slots_.find(key);
-    if (queue_empty && sit != slots_.end() && !sit->second.empty()) {
-        RecvSlot *slot = sit->second.front();
-        sit->second.pop_front();
-        if (sit->second.empty()) slots_.erase(sit);
-        if (slot->cap >= msg.data.size()) {
-            std::memcpy(slot->buf, msg.data.data(), msg.data.size());
-            slot->len = msg.data.size();
-            slot->state = RecvSlot::done;
-            BufferPool::instance().put(std::move(msg.data));
-            cv_.notify_all();
-            return;
+    if (queue_empty && sit != slots_.end()) {
+        // offer to waiting slots in FIFO order; undersized registrations
+        // (an API-contract violation) are failed and skipped so a later,
+        // big-enough slot is not stranded watching an unclaimable queue
+        auto &dq = sit->second;
+        while (!dq.empty()) {
+            RecvSlot *slot = dq.front();
+            dq.pop_front();
+            if (slot->cap >= msg.data.size()) {
+                if (dq.empty()) slots_.erase(sit);
+                std::memcpy(slot->buf, msg.data.data(), msg.data.size());
+                slot->len = msg.data.size();
+                slot->state = RecvSlot::done;
+                BufferPool::instance().put(std::move(msg.data));
+                cv_.notify_all();
+                return;
+            }
+            slot->state = RecvSlot::failed;
         }
-        slot->state = RecvSlot::failed;  // undersized registration
+        slots_.erase(sit);
     }
     q_[key].push_back(std::move(msg.data));
     cv_.notify_all();
@@ -441,7 +473,10 @@ int Client::dial_fd(const PeerID &dest) {
             ua.sun_family = AF_UNIX;
             const std::string path = sock_path(dest);
             std::strncpy(ua.sun_path, path.c_str(), sizeof(ua.sun_path) - 1);
-            if (::connect(fd, (sockaddr *)&ua, sizeof(ua)) == 0) return fd;
+            if (::connect(fd, (sockaddr *)&ua, sizeof(ua)) == 0) {
+                grow_unix_bufs(fd);
+                return fd;
+            }
             ::close(fd);
         }
     }
@@ -613,7 +648,7 @@ int Server::start() {
         listen_fd_ = -1;
         return KF_ERR;
     }
-    if (!unix_sockets_disabled()) {
+    if (!unix_sockets_disabled() && ensure_sock_dir()) {
         unix_path_ = sock_path(self_);
         ::unlink(unix_path_.c_str());  // stale socket from a dead process
         unix_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
@@ -683,6 +718,8 @@ void Server::accept_loop(int listen_fd, bool tcp) {
         if (tcp) {
             int one = 1;
             ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        } else {
+            grow_unix_bufs(fd);
         }
         {
             std::lock_guard<std::mutex> lk(mu_);

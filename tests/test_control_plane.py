@@ -363,8 +363,8 @@ class TestUnixSocketTransport:
                  for p in ports]
         for p in peers:
             p.start()
-        # 127.0.0.1 == 0x7f000001 in the socket filename
-        socks = [f"/tmp/kf-u{os.getuid()}-7f000001-{p}.sock" for p in ports]
+        # 127.0.0.1 == 0x7f000001; sockets live in the per-uid 0700 dir
+        socks = [f"/tmp/kf-u{os.getuid()}/7f000001-{p}.sock" for p in ports]
         try:
             for s in socks:
                 assert os.path.exists(s)  # one listener per colocated peer
