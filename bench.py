@@ -15,9 +15,15 @@ ResNet-50 fp32 V100 figure of the Horovod-era systems the reference
 benchmarks against on 16xV100 (reference README.md:197-205 plots relative
 throughput on that hardware; no absolute numbers are published, so the
 per-chip V100 figure anchors the comparison).
+
+Set KF_BENCH_PROFILE=<dir> to capture a jax.profiler trace of the timed
+iterations (view with tensorboard / xprof). Roofline context for the
+number this prints: see docs/benchmarks.md "Single-chip roofline".
 """
 
+import contextlib
 import json
+import os
 import time
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 360.0  # ResNet-50 fp32 on V100
@@ -75,12 +81,16 @@ def main():
     # report absurd throughput; a scalar fetch is a true execution fence
     float(loss)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params_s, stats_s, opt_s, loss = step(params_s, stats_s, opt_s,
-                                              batch_s)
-    final_loss = float(loss)  # fences the whole dependent step chain
-    dt = time.perf_counter() - t0
+    profile_dir = os.environ.get("KF_BENCH_PROFILE")
+    trace = (jax.profiler.trace(profile_dir) if profile_dir
+             else contextlib.nullcontext())
+    with trace:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params_s, stats_s, opt_s, loss = step(params_s, stats_s, opt_s,
+                                                  batch_s)
+        final_loss = float(loss)  # fences the whole dependent step chain
+        dt = time.perf_counter() - t0
     assert final_loss == final_loss, "NaN loss in benchmark"
 
     images_per_sec = global_batch * iters / dt

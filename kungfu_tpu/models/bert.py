@@ -3,8 +3,10 @@
 The reference benchmarks all-reduce over BERT's tensor catalog
 (reference: srcs/python/kungfu/tensorflow/v1/benchmarks/model_sizes.py,
 tests/cpp/integration/bert.hpp). Here it is a real flax encoder:
-bfloat16 matmuls sized for the MXU (hidden 768 = 6x128, heads 12x64),
-f32 layernorm/softmax accumulations.
+bfloat16 matmuls sized for the MXU (hidden 768 = 6x128, heads 12x64);
+layernorms compute in bf16 with f32 scale/bias (flax reduces LN mean/var
+in f32 internally), so residual-stream activations stay 2 bytes/elem in
+HBM; only the logits head is f32.
 """
 
 from __future__ import annotations
@@ -33,14 +35,14 @@ class TransformerLayer(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None):
         c = self.config
-        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         y = nn.MultiHeadDotProductAttention(
             num_heads=c.num_heads,
             dtype=c.dtype,
             qkv_features=c.hidden_size,
         )(y, y, mask=mask)
         x = x + y
-        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         y = nn.Dense(c.intermediate_size, dtype=c.dtype)(y)
         y = nn.gelu(y)
         y = nn.Dense(c.hidden_size, dtype=c.dtype)(y)
@@ -59,8 +61,8 @@ class BertEncoder(nn.Module):
         x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype)(token_ids)
         x = x + nn.Embed(c.max_position, c.hidden_size,
                          dtype=c.dtype)(pos)
-        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         for _ in range(c.num_layers):
             x = TransformerLayer(c)(x, mask=mask)
-        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         return nn.Dense(c.vocab_size, dtype=jnp.float32)(x)
