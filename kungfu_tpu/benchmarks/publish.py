@@ -1,0 +1,411 @@
+"""Run every BASELINE config and record the results.
+
+The reference publishes relative-throughput / convergence plots across
+five scenarios (reference: README.md:188-205, benchmarks/{system,
+adaptation,monitoring}/); `BASELINE.json` declares the TPU-rebuild
+equivalents. This module runs the four non-headline configs (the
+ResNet-50 headline lives in `bench.py`) and merges the numbers into
+`BASELINE.json.published`:
+
+  mnist-slp          MNIST SLP + SyncSGD: throughput + final accuracy
+                     (reference: examples/tf2_mnist_gradient_tape.py).
+  pair-convergence   PairAveraging vs SyncSGD vs SMA on the same data +
+                     step budget: does decentralized gossip converge?
+                     (reference: PairAveragingOptimizer claims,
+                     README.md:188-193).
+  bert-sma-gns       BERT-ish encoder + SMA, with/without the
+                     gradient-noise-scale monitor: monitoring overhead
+                     (reference: benchmarks/monitoring/benchmark.py).
+  adaptation         online resize latency via the elastic runtime
+                     (reference: benchmarks/adaptation/).
+
+Each subcommand prints ONE JSON line. `--all` runs each config in a
+subprocess pinned to an 8-device virtual CPU mesh (deterministic,
+hardware-independent; the headline number is the TPU one) and rewrites
+`BASELINE.json`:
+
+  python -m kungfu_tpu.benchmarks.publish --all [--json path/BASELINE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+N_WORKERS = 8  # virtual CPU mesh width for the published configs
+
+
+def _synthetic_mnist(n=8192, seed=0):
+    """Deterministic MNIST-shaped data (examples/common.py without the
+    examples/ dir on sys.path)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, size=n)
+    centers = rng.normal(0.5, 0.5, size=(10, 28 * 28))
+    x = centers[y] + rng.normal(0.0, 0.35, size=(n, 28 * 28))
+    x = np.clip(x, 0.0, 1.0).astype(np.float32).reshape(n, 28, 28, 1)
+    return x, y.astype(np.int32)
+
+
+def _slp_setup(mesh, lr=0.1):
+    import jax
+    import optax
+
+    from kungfu_tpu.models import SLP
+
+    model = SLP(num_classes=10)
+    x, y = _synthetic_mnist()
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    def acc_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"])
+        return (logits.argmax(-1) == batch["y"]).mean()
+
+    return model, x, y, params, loss_fn, acc_fn
+
+
+def _train(tx, mesh, steps, batch_per_worker, loss_fn, params, x, y,
+           per_worker_streams=False):
+    """Run `steps` of the compiled SPMD step; returns final stacked params
+    and wall seconds over the timed region."""
+    import jax
+
+    from kungfu_tpu.data import ElasticSampler
+    from kungfu_tpu.parallel import (
+        build_train_step,
+        init_worker_state,
+        replicate_to_workers,
+        shard_batch,
+    )
+
+    n = jax.device_count()
+    params_s = replicate_to_workers(params, mesh)
+    opt_s = init_worker_state(tx, params_s, mesh)
+    step = build_train_step(loss_fn, tx, mesh)
+
+    if per_worker_streams:
+        # averaging runs decorrelate rows: per-worker sample streams
+        samplers = [
+            ElasticSampler(len(x), batch_per_worker, rank=r, size=n, seed=1)
+            for r in range(n)
+        ]
+
+        def next_batch():
+            import numpy as np
+
+            idx = np.concatenate([s.next_indices() for s in samplers])
+            return {"x": x[idx], "y": y[idx]}
+    else:
+        sampler = ElasticSampler(len(x), batch_per_worker * n, rank=0,
+                                 size=1, seed=1)
+
+        def next_batch():
+            idx = sampler.next_indices()
+            return {"x": x[idx], "y": y[idx]}
+
+    # warmup/compile step outside the timed region
+    b0 = shard_batch(next_batch(), mesh)
+    params_s, opt_s, _ = step(params_s, opt_s, b0)
+    jax.block_until_ready(params_s)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        batch = shard_batch(next_batch(), mesh)
+        params_s, opt_s, _ = step(params_s, opt_s, batch)
+    jax.block_until_ready(params_s)
+    return params_s, time.perf_counter() - t0
+
+
+def _accuracy(params_s, acc_fn, mesh, x, y, row=0):
+    """Full-dataset accuracy of worker `row`'s model."""
+    import jax
+    import numpy as np
+
+    params = jax.tree_util.tree_map(lambda t: t[row], params_s)
+    correct = 0
+    for i in range(0, len(x), 2048):
+        batch = {"x": x[i:i + 2048], "y": y[i:i + 2048]}
+        correct += float(acc_fn(params, batch)) * len(batch["y"])
+    return correct / len(x)
+
+
+def run_mnist_slp(args):
+    import jax
+
+    from kungfu_tpu.optimizers import sync_sgd
+    import optax
+
+    from kungfu_tpu.parallel import data_mesh
+
+    n = jax.device_count()
+    mesh = data_mesh(n)
+    model, x, y, params, loss_fn, acc_fn = _slp_setup(mesh)
+    tx = sync_sgd(optax.sgd(args.lr))
+    params_s, secs = _train(tx, mesh, args.steps, args.batch, loss_fn,
+                            params, x, y)
+    acc = _accuracy(params_s, jax.jit(acc_fn), mesh, x, y)
+    images = args.steps * args.batch * n
+    return {
+        "config": (
+            f"MNIST-shaped SLP, SyncSGD(sgd {args.lr}), {n} workers x "
+            f"batch {args.batch}, {args.steps} steps, synthetic data "
+            "(zero-egress; examples/common.py distribution)"
+        ),
+        "final_train_accuracy": round(acc, 4),
+        "images_per_sec": round(images / secs, 1),
+        "workers": n,
+    }
+
+
+def run_pair_convergence(args):
+    import jax
+    import optax
+
+    from kungfu_tpu.optimizers import pair_averaging, sma, sync_sgd
+    from kungfu_tpu.parallel import data_mesh
+
+    n = jax.device_count()
+    mesh = data_mesh(n)
+    model, x, y, params, loss_fn, acc_fn = _slp_setup(mesh)
+    jit_acc = jax.jit(acc_fn)
+    budgets = {"converged": (args.steps, args.lr),
+               "tight_budget": (max(args.steps // 30, 5), args.lr / 5)}
+    out = {}
+    for bname, (steps, lr) in budgets.items():
+        accs = {}
+        for name, tx, streams in (
+            ("sync_sgd", sync_sgd(optax.sgd(lr)), False),
+            ("pair_averaging", pair_averaging(optax.sgd(lr)), True),
+            ("sma", sma(optax.sgd(lr), alpha=0.1), True),
+        ):
+            params_s, _ = _train(tx, mesh, steps, args.batch, loss_fn,
+                                 params, x, y, per_worker_streams=streams)
+            # averaging runs: every row must independently be a good model
+            row_accs = [_accuracy(params_s, jit_acc, mesh, x, y, row=r)
+                        for r in (0, n - 1)]
+            accs[name] = round(min(row_accs), 4)
+        out[bname] = {"steps": steps, "lr": lr, "accuracy": accs,
+                      "pair_vs_sync_gap": round(
+                          accs["sync_sgd"] - accs["pair_averaging"], 4)}
+    return {
+        "config": (
+            f"{n} workers x batch {args.batch}, same data + step budget "
+            "per variant; accuracy is the WORST worker row (averaging "
+            "runs must leave every row a good model)"
+        ),
+        "budgets": out,
+        "workers": n,
+    }
+
+
+def run_bert_sma_gns(args):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kungfu_tpu.models import BertConfig, BertEncoder
+    from kungfu_tpu.optimizers import attach_gradient_noise_scale, sma
+    from kungfu_tpu.parallel import (
+        build_train_step,
+        data_mesh,
+        init_worker_state,
+        replicate_to_workers,
+        shard_batch,
+    )
+
+    n = jax.device_count()
+    mesh = data_mesh(n)
+    platform = jax.devices()[0].platform
+    cfg = (BertConfig()  # BERT-base
+           if platform != "cpu" else
+           BertConfig(num_layers=2, hidden_size=128, num_heads=2,
+                      intermediate_size=512, vocab_size=1024,
+                      max_position=128))
+    seq = 128 if platform != "cpu" else 64
+    model = BertEncoder(cfg)
+    # varied tokens per worker so cross-worker gradient noise is
+    # non-degenerate; MLM-style objective against the encoder's own head
+    kt, kl = jax.random.split(jax.random.PRNGKey(2))
+    tokens = jax.random.randint(kt, (args.batch * n, seq), 0,
+                                cfg.vocab_size, jnp.int32)
+    labels = jax.random.randint(kl, (args.batch * n, seq), 0,
+                                cfg.vocab_size, jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"])  # [B, T, V]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    batch = shard_batch({"x": tokens, "y": labels}, mesh)
+    variants = {}
+    for name, tx in (
+        ("sma", sma(optax.sgd(args.lr), alpha=0.1)),
+        ("sma+gns", attach_gradient_noise_scale(
+            sma(optax.sgd(args.lr), alpha=0.1),
+            device_batch_size=args.batch)),
+    ):
+        params_s = replicate_to_workers(params, mesh)
+        opt_s = init_worker_state(tx, params_s, mesh)
+        step = build_train_step(loss_fn, tx, mesh)
+        for _ in range(2):  # compile + warm
+            params_s, opt_s, _ = step(params_s, opt_s, batch)
+        jax.block_until_ready(params_s)
+        variants[name] = (step, params_s, opt_s)
+
+    # interleave short blocks of each variant and take medians, so shared
+    # machine-load drift cancels instead of appearing as monitor overhead
+    import numpy as np
+
+    block = 3
+    samples = {name: [] for name in variants}
+    for _ in range(max(args.iters // block, 4)):
+        for name, (step, params_s, opt_s) in variants.items():
+            t0 = time.perf_counter()
+            for _ in range(block):
+                params_s, opt_s, _ = step(params_s, opt_s, batch)
+            jax.block_until_ready(params_s)
+            samples[name].append(
+                (time.perf_counter() - t0) / block * 1e3)
+            variants[name] = (step, params_s, opt_s)
+    times = {name: float(np.median(v)) for name, v in samples.items()}
+    overhead = 100.0 * (times["sma+gns"] - times["sma"]) / times["sma"]
+    return {
+        "config": (
+            f"BERT encoder L{cfg.num_layers}/H{cfg.hidden_size} seq {seq}, "
+            f"SMA(alpha=0.1) with vs without GNS monitor, {n} workers x "
+            f"batch {args.batch} ({platform}; interleaved-block medians)"
+        ),
+        "sma_ms_per_step": round(times["sma"], 3),
+        "sma_gns_ms_per_step": round(times["sma+gns"], 3),
+        "gns_overhead_pct": round(overhead, 1),
+        "workers": n,
+    }
+
+
+def run_adaptation(args):
+    """Elastic resize latency: drive the real multi-process runtime."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.benchmarks.adaptation",
+         "--launch", "--schedule", "3:2,3:4,3:1", "--steps", "9",
+         "--np", "2", "--payload-mb", str(args.payload_mb),
+         "--port-range", "28100-28999"],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    summary = None
+    for line in (out.stdout + out.stderr).splitlines():
+        # worker stdout arrives with a colored "[rank]" prefix
+        pos = line.find("adaptation np0=")
+        if pos >= 0:
+            summary = line[pos:]
+    if out.returncode != 0 or summary is None:
+        raise RuntimeError(
+            f"adaptation bench failed rc={out.returncode}:\n"
+            f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}")
+    # "adaptation np0=2 resizes=2 payload=4MiB mean=X ms max=Y ms"
+    fields = dict(
+        kv.split("=") for kv in summary.split() if "=" in kv)
+    return {
+        "config": (
+            "elastic run: schedule 2->4->1 workers, "
+            f"{args.payload_mb} MiB joiner payload "
+            "(98 MiB = fp32 ResNet-50 state), real kfrun + config "
+            "server + consensus resize + resync (loopback; worker-spawn "
+            "+ JAX import dominates on few-core hosts)"
+        ),
+        "resizes": int(fields["resizes"]),
+        "mean_resize_ms": float(fields["mean"]),
+        "max_resize_ms": float(fields["max"]),
+    }
+
+
+CONFIG_KEYS = {
+    "mnist-slp": ("mnist_slp_syncsgd", run_mnist_slp),
+    "pair-convergence": ("resnet50_pair_averaging_convergence_proxy",
+                         run_pair_convergence),
+    "bert-sma-gns": ("bert_sma_gns_monitor", run_bert_sma_gns),
+    "adaptation": ("elastic_adaptation_latency", run_adaptation),
+}
+
+
+def run_all(args):
+    """Run each config in a subprocess on a virtual 8-device CPU mesh and
+    merge the results into BASELINE.json."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    json_path = args.json or os.path.join(here, "BASELINE.json")
+    with open(json_path) as f:
+        baseline = json.load(f)
+    published = baseline.setdefault("published", {})
+    for sub, (key, _) in CONFIG_KEYS.items():
+        env = dict(os.environ)
+        if sub != "adaptation":  # adaptation pins its workers itself
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={N_WORKERS}"
+            ).strip()
+        t0 = time.perf_counter()
+        out = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.benchmarks.publish", sub],
+            env=env, capture_output=True, text=True, timeout=1200,
+        )
+        if out.returncode != 0:
+            print(f"FAIL {sub}:\n{out.stdout[-2000:]}\n"
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+            return 1
+        line = out.stdout.strip().splitlines()[-1]
+        result = json.loads(line)
+        result["round"] = args.round
+        published[key] = result
+        # write after every config so a late failure keeps earlier results
+        with open(json_path, "w") as f:
+            json.dump(baseline, f, indent=2)
+            f.write("\n")
+        print(f"ok {sub} ({time.perf_counter() - t0:.0f}s): {line}",
+              flush=True)
+    print(f"published {len(CONFIG_KEYS)} configs -> {json_path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("subcommand", nargs="?", choices=sorted(CONFIG_KEYS))
+    ap.add_argument("--all", dest="all_", action="store_true",
+                    help="run every config and update BASELINE.json")
+    ap.add_argument("--json", default="", help="path to BASELINE.json")
+    ap.add_argument("--round", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--payload-mb", type=int, default=98,
+                    help="joiner payload; 98 MiB = fp32 ResNet-50 state")
+    args = ap.parse_args(argv)
+    if args.all_ or args.subcommand is None:
+        return run_all(args)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # this environment's TPU PJRT plugin wins over the env var; the
+        # CPU backend must be forced before any backend initializes
+        # (same dance as tests/conftest.py)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    _, fn = CONFIG_KEYS[args.subcommand]
+    print(json.dumps(fn(args)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,7 @@ propose/resize/state-resync from inside a training loop.
 
 from .config_server import ConfigServer
 from .hooks import ElasticCallback, ElasticState
+from .policy import NoiseScalePolicy
 from .schedule import step_based_schedule
 
 __all__ = [
@@ -15,4 +16,5 @@ __all__ = [
     "step_based_schedule",
     "ElasticCallback",
     "ElasticState",
+    "NoiseScalePolicy",
 ]

@@ -50,9 +50,15 @@ class ElasticCallback:
         schedule: str = "",
         config_server: str = "",
         samples_per_step: int = 0,
+        policy=None,
     ):
+        """`policy` is a callable ``(current_size) -> Optional[int]``
+        (e.g. :class:`~kungfu_tpu.elastic.NoiseScalePolicy`) consulted
+        when no static schedule is given — the monitor-driven form of
+        the reference's schedule-driven resize."""
         self.peer = peer
         self.schedule = schedule
+        self.policy = policy
         self.config_server = config_server or peer.config.config_server
         self.samples_per_step = samples_per_step
         self.state = ElasticState()
@@ -63,13 +69,18 @@ class ElasticCallback:
         st = self.state
         st.step += 1
         st.trained_samples += self.samples_per_step * self.peer.size
+        want = None
         if self.schedule:
             want = step_based_schedule(self.schedule, st.step)
-            if want != self.peer.size and self.peer.rank == 0:
-                try:
-                    self.peer.propose_new_size(want, self.config_server)
-                except Exception as e:  # config server hiccup: retry later
-                    print(f"[kf-elastic] propose failed: {e}", flush=True)
+            if want == self.peer.size:
+                want = None
+        elif self.policy is not None:
+            want = self.policy(self.peer.size)
+        if want is not None and self.peer.rank == 0:
+            try:
+                self.peer.propose_new_size(want, self.config_server)
+            except Exception as e:  # config server hiccup: retry later
+                print(f"[kf-elastic] propose failed: {e}", flush=True)
         changed, keep = self.peer.resize_from_url(self.config_server)
         st.changed, st.keep = changed, keep
         return changed

@@ -1,0 +1,71 @@
+"""Monitor-driven cluster sizing: the adaptation loop, closed.
+
+The reference computes the gradient noise scale and prints it
+(reference: srcs/python/kungfu/tensorflow/optimizers/grad_noise_scale.py:
+37-69) — the adaptation story (README "adaptive training") leaves acting
+on it to the user. Here the statistic drives the elastic runtime
+directly: a policy maps the observed noise scale to a desired cluster
+size, and `ElasticCallback` proposes it through the config server, where
+the consensus-resize machinery (peer.resize_from_url) takes over.
+
+The sizing rule follows the GNS paper ("An Empirical Model of
+Large-Batch Training"): training is efficient while the global batch is
+below the noise scale, so the target worker count is the one whose
+global batch tracks ``noise_scale / device_batch``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NoiseScalePolicy:
+    """Maps an EMA'd noise-scale reading to a proposed cluster size.
+
+    Use with :class:`~kungfu_tpu.elastic.ElasticCallback`::
+
+        policy = NoiseScalePolicy(device_batch=64, max_size=8)
+        elastic = ElasticCallback(peer, policy=policy)
+        ...
+        policy.observe(float(opt_state.noise_scale))   # from GNS monitor
+        if elastic.after_step():
+            ...
+
+    `hysteresis` consecutive identical targets are required before the
+    policy emits a proposal, so one noisy estimate cannot churn the
+    cluster (resizes cost a recompile + resync).
+    """
+
+    device_batch: int
+    min_size: int = 1
+    max_size: int = 8
+    hysteresis: int = 2
+    noise_scale: float = 0.0
+    _pending: int = field(default=0, repr=False)
+    _streak: int = field(default=0, repr=False)
+
+    def observe(self, noise_scale: float) -> None:
+        """Feed the latest monitor reading (e.g. GNSMonitorState.noise_scale)."""
+        self.noise_scale = float(noise_scale)
+
+    def target_size(self) -> int:
+        want = round(self.noise_scale / max(self.device_batch, 1))
+        return max(self.min_size, min(self.max_size, want))
+
+    def __call__(self, current_size: int) -> int | None:
+        """Desired cluster size, or None to leave the cluster alone."""
+        if self.noise_scale <= 0.0:
+            return None
+        want = self.target_size()
+        if want == current_size:
+            self._streak = 0
+            return None
+        if want == self._pending:
+            self._streak += 1
+        else:
+            self._pending, self._streak = want, 1
+        if self._streak >= self.hysteresis:
+            self._streak = 0
+            return want
+        return None

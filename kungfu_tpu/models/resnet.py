@@ -83,9 +83,10 @@ class ResNet(nn.Module):
         # BatchNorm computes in the model dtype (bf16) but keeps its
         # scale/bias/running stats in f32 (param_dtype), and flax computes
         # batch mean/var in f32 internally — the standard TPU recipe.
-        # Running BN in f32 end-to-end costs ~23% step time: the whole
+        # Running BN in f32 end-to-end costs ~20% step time: the whole
         # BN+relu elementwise chain then moves f32 activations through HBM
-        # (measured 65.3ms -> 50.1ms per b=128 step on a v5e chip).
+        # (63.4 ms -> 50.4 ms per b=128 step on a v5e chip; the published
+        # run in docs/benchmarks.md "Single-chip roofline").
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,

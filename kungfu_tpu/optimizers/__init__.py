@@ -21,6 +21,7 @@ a mesh axis), so the communication compiles onto ICI.
 from .ada_sgd import ada_sgd
 from .async_sgd import PairAveragingState, pair_averaging
 from .monitors import (
+    attach_gradient_noise_scale,
     GNSMonitorState,
     VarianceMonitorState,
     monitor_gradient_noise_scale,
@@ -37,6 +38,7 @@ __all__ = [
     "ada_sgd",
     "monitor_gradient_noise_scale",
     "monitor_gradient_variance",
+    "attach_gradient_noise_scale",
     "GNSMonitorState",
     "VarianceMonitorState",
 ]

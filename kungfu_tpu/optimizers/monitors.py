@@ -7,9 +7,12 @@ prints via tf.print, these keep the latest statistic in optimizer state so
 the training loop (or an adaptation policy) reads it directly — the
 statistic is what drives adaptive batch-size/cluster-size decisions.
 
-Both monitors piggyback on the S-SGD all-reduce: GNS costs no extra
-collective (it reuses local + averaged gradients); variance costs one
-extra psum of squared gradients.
+Collective cost: the S-SGD forms (`monitor_gradient_noise_scale`,
+`monitor_gradient_variance`) piggyback on the gradient all-reduce they
+already perform (GNS reuses local + averaged gradients; variance adds one
+psum of squared gradients). `attach_gradient_noise_scale` wraps transforms
+that do NOT average gradients, so its all-reduce is a real extra per-step
+collective.
 """
 
 from __future__ import annotations
@@ -38,14 +41,23 @@ class GNSMonitorState(NamedTuple):
     inner: optax.OptState
 
 
-def monitor_gradient_noise_scale(
+def _gns_monitored(
     inner: optax.GradientTransformation,
     device_batch_size: int,
-    axis_name: str = "data",
-    alpha: float = 0.6,
-    interval: int = 1,
+    axis_name: str,
+    alpha: float,
+    interval: int,
+    feed_averaged_to_inner: bool,
 ) -> optax.GradientTransformation:
-    """S-SGD whose state tracks the gradient noise scale B_noise."""
+    """Shared GNS-monitor builder; the flag selects which gradients the
+    inner transform consumes (averaged = S-SGD semantics, raw = leave the
+    inner optimizer's own collective behavior untouched).
+
+    `interval` gates only the statistic's EMA commit: the extra
+    all-reduce + norm reductions run every step regardless (the tick is a
+    traced value, so XLA cannot elide the collective — an interval > 1
+    reduces estimate churn, not cost).
+    """
 
     def init(params):
         return GNSMonitorState(
@@ -72,13 +84,48 @@ def monitor_gradient_noise_scale(
             lambda new, old: jnp.where(tick, new, old), new_gns, state.gns
         )
         noise = jnp.where(tick, estimate, state.noise_scale)
-        updates, new_inner = inner.update(avg_grads, state.inner, params)
+        inner_grads = avg_grads if feed_averaged_to_inner else grads
+        updates, new_inner = inner.update(inner_grads, state.inner, params)
         return updates, GNSMonitorState(
             step=state.step + 1, gns=gns_state, noise_scale=noise,
             inner=new_inner,
         )
 
     return optax.GradientTransformation(init, update)
+
+
+def monitor_gradient_noise_scale(
+    inner: optax.GradientTransformation,
+    device_batch_size: int,
+    axis_name: str = "data",
+    alpha: float = 0.6,
+    interval: int = 1,
+) -> optax.GradientTransformation:
+    """S-SGD whose state tracks the gradient noise scale B_noise."""
+    return _gns_monitored(inner, device_batch_size, axis_name, alpha,
+                          interval, feed_averaged_to_inner=True)
+
+
+def attach_gradient_noise_scale(
+    inner: optax.GradientTransformation,
+    device_batch_size: int,
+    axis_name: str = "data",
+    alpha: float = 0.6,
+    interval: int = 1,
+) -> optax.GradientTransformation:
+    """Attach the GNS monitor to ANY transform without altering it.
+
+    Unlike :func:`monitor_gradient_noise_scale` (which is S-SGD plus the
+    statistic), this passes the RAW local gradients through to ``inner``,
+    so model-averaging optimizers (SMA, pair averaging) keep their exact
+    semantics — the configuration the reference's BERT benchmark runs
+    (SynchronousAveragingOptimizer + noise-scale monitor, reference:
+    srcs/python/kungfu/tensorflow/optimizers/grad_noise_scale.py:37-69
+    wrapping any optimizer passed in). Costs one extra all-reduce to form
+    the large-batch gradient the estimator compares against.
+    """
+    return _gns_monitored(inner, device_batch_size, axis_name, alpha,
+                          interval, feed_averaged_to_inner=False)
 
 
 class VarianceMonitorState(NamedTuple):

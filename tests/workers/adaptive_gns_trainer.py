@@ -1,0 +1,91 @@
+"""Adaptive GNS trainer: the noise-scale monitor drives a live resize.
+
+The closed adaptation loop the reference markets but leaves to the user
+(reference: srcs/python/kungfu/tensorflow/optimizers/grad_noise_scale.py
+computes + prints; hooks/elastic.py resizes from a static schedule): here
+the monitor's reading feeds NoiseScalePolicy, which proposes through the
+config server and the consensus-resize machinery takes over.
+
+Each worker runs a private 2-device virtual CPU mesh so the GNS monitor
+has a cross-device axis. Synthetic gradients are mean 1 with per-device
+noise sigma that ramps at TEST_RAMP_STEP, so the noise-scale estimate
+(~sigma^2) jumps and the policy's target size crosses from min to max.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import sys  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import kungfu_tpu  # noqa: E402
+from kungfu_tpu.elastic import ElasticCallback, NoiseScalePolicy  # noqa: E402
+from kungfu_tpu.optimizers import monitor_gradient_noise_scale  # noqa: E402
+from kungfu_tpu.parallel import (  # noqa: E402
+    build_train_step,
+    data_mesh,
+    init_worker_state,
+    replicate_to_workers,
+    shard_batch,
+)
+
+TOTAL = int(os.environ.get("TEST_TOTAL_STEPS", "10"))
+RAMP = int(os.environ.get("TEST_RAMP_STEP", "4"))
+B = 8  # device batch
+
+p = kungfu_tpu.init()
+policy = NoiseScalePolicy(device_batch=B, min_size=2, max_size=4,
+                          hysteresis=2)
+elastic = ElasticCallback(p, policy=policy, samples_per_step=B)
+if p.config.version > 0:
+    elastic.sync_position()
+    print(f"joined at epoch {p.config.version} step {elastic.state.step}",
+          flush=True)
+
+mesh = data_mesh(2)
+params = {"w": jnp.zeros((4,), jnp.float32)}
+tx = monitor_gradient_noise_scale(optax.sgd(0.05), device_batch_size=B)
+
+
+def loss_fn(params, batch):
+    # d loss / d w = device-batch mean of the injected gradient rows
+    return jnp.vdot(params["w"], batch["g"].mean(axis=0))
+
+
+step_fn = build_train_step(loss_fn, tx, mesh)
+params_s = replicate_to_workers(params, mesh)
+opt_s = init_worker_state(tx, params_s, mesh)
+
+rng = np.random.default_rng(1234 + p.rank)
+while elastic.state.step < TOTAL:
+    t = elastic.state.step
+    sigma = 0.05 if t < RAMP else 40.0  # noise scale ~ sigma^2
+    g = (1.0 + sigma * rng.normal(size=(2 * B, 4))).astype(np.float32)
+    batch = shard_batch({"g": jnp.asarray(g)}, mesh)
+    params_s, opt_s, _ = step_fn(params_s, opt_s, batch)
+    noise = float(np.asarray(jax.device_get(opt_s.noise_scale))[0])
+    policy.observe(noise)
+    print(f"step {t} noise {noise:.2f} target {policy.target_size()}",
+          flush=True)
+    if elastic.after_step():
+        if not elastic.state.keep:
+            print(f"evicted at step {elastic.state.step}", flush=True)
+            sys.exit(0)
+        elastic.sync_position()
+        print(f"monitor-resize epoch {p.version}: size={p.size} "
+              f"step={elastic.state.step}", flush=True)
+
+print(f"finished rank={p.rank} size={p.size} step={elastic.state.step} "
+      f"gns={policy.noise_scale:.2f}", flush=True)
