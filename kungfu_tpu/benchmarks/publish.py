@@ -357,8 +357,12 @@ def run_all(args):
                 + f" --xla_force_host_platform_device_count={N_WORKERS}"
             ).strip()
         t0 = time.perf_counter()
+        flags = ["--steps", str(args.steps), "--iters", str(args.iters),
+                 "--batch", str(args.batch), "--lr", str(args.lr),
+                 "--payload-mb", str(args.payload_mb)]
         out = subprocess.run(
-            [sys.executable, "-m", "kungfu_tpu.benchmarks.publish", sub],
+            [sys.executable, "-m", "kungfu_tpu.benchmarks.publish", sub,
+             *flags],
             env=env, capture_output=True, text=True, timeout=1200,
         )
         if out.returncode != 0:

@@ -142,6 +142,9 @@ def load() -> ctypes.CDLL:
         "kf_accumulate": ([P, P, i64, ctypes.c_int, ctypes.c_int,
                            ctypes.c_int], ctypes.c_int),
         "kf_simd_enabled": ([ctypes.c_int], ctypes.c_int),
+        "kf_trace_report": ([ctypes.c_char_p, i64], i64),
+        "kf_trace_reset": ([], None),
+        "kf_trace_enabled": ([], ctypes.c_int),
         "kf_order_group_new": ([ctypes.c_int, ctypes.POINTER(ctypes.c_int)],
                                P),
         "kf_order_group_start": ([P, ctypes.c_int, TASK_CB, P], ctypes.c_int),
@@ -201,6 +204,34 @@ def accumulate(dst: np.ndarray, src: np.ndarray, op: str = "sum", *,
 def simd_enabled(dt) -> bool:
     """True when this process reduces `dt` with vector kernels."""
     return bool(load().kf_simd_enabled(dtype_code(np.dtype(dt))))
+
+
+def trace_enabled() -> bool:
+    """True when KF_TRACE=1 was set when libkf first checked."""
+    return bool(load().kf_trace_enabled())
+
+
+def trace_report() -> dict:
+    """Scoped-timer profile of libkf hot paths, keyed by scope name.
+
+    Each value is {"count", "total_us", "max_us"} accumulated since start
+    (or the last trace_reset). Empty when KF_TRACE is off (reference:
+    TRACE_SCOPE, srcs/cpp/include/kungfu/utils/trace.hpp:1-16 — logged
+    per-event there, aggregated here because hot paths run millions of
+    times).
+    """
+    buf = ctypes.create_string_buffer(16384)
+    n = load().kf_trace_report(buf, len(buf))
+    out = {}
+    for line in buf.raw[:n].decode().splitlines():
+        scope, count, total_us, max_us = line.split()
+        out[scope] = {"count": int(count), "total_us": int(total_us),
+                      "max_us": int(max_us)}
+    return out
+
+
+def trace_reset() -> None:
+    load().kf_trace_reset()
 
 
 class OrderGroup:

@@ -55,6 +55,16 @@ class MetricsServer:
             f'kf_egress_bytes_per_sec{{rank="{rank}"}} {eg_rate:.1f}',
             f'kf_ingress_bytes_per_sec{{rank="{rank}"}} {in_rate:.1f}',
         ]
+        # scoped hot-path timers (KF_TRACE=1): send/dial/recv_wait/...
+        from .ffi import trace_report
+
+        for scope, c in trace_report().items():
+            tags = f'{{rank="{rank}",scope="{scope}"}}'
+            lines += [
+                f"kf_trace_count{tags} {c['count']}",
+                f"kf_trace_total_us{tags} {c['total_us']}",
+                f"kf_trace_max_us{tags} {c['max_us']}",
+            ]
         return "\n".join(lines) + "\n"
 
     def start(self) -> "MetricsServer":

@@ -164,6 +164,18 @@ int kf_accumulate(void *dst, const void *src, int64_t count, int dtype,
 /* 1 if this process will use SIMD kernels for the given dtype, else 0. */
 int kf_simd_enabled(int dtype);
 
+/* --- tracing ------------------------------------------------------------- */
+
+/* Scoped timers around libkf hot paths (send / dial / recv_wait /
+ * accumulate / collective), enabled by KF_TRACE=1 in the environment.
+ * Fills `buf` with "scope count total_us max_us" lines (NUL-terminated,
+ * truncated at cap-1) and returns the bytes written; 0 when tracing is
+ * off or nothing has been recorded yet. Process-global. */
+int64_t kf_trace_report(char *buf, int64_t cap);
+void kf_trace_reset(void);
+/* 1 when KF_TRACE was set at first use, else 0. */
+int kf_trace_enabled(void);
+
 /* library version string */
 const char *kf_version_string(void);
 

@@ -7,6 +7,7 @@
 
 #include "ordergroup.hpp"
 #include "peer.hpp"
+#include "trace.hpp"
 
 using namespace kf;
 
@@ -76,11 +77,13 @@ uint32_t kf_version(kf_peer *p) { return p->impl.version(); }
 uint64_t kf_uid(kf_peer *p) { return p->impl.uid(); }
 
 int kf_barrier(kf_peer *p) {
+    TraceScope trace(Tracer::COLLECTIVE);
     return with_session(p, [](Session *s) { return s->barrier(); });
 }
 
 int kf_all_reduce(kf_peer *p, const void *send, void *recv, int64_t count,
                   int dtype, int op, const char *name) {
+    TraceScope trace(Tracer::COLLECTIVE);
     return with_session(p, [&](Session *s) {
         return s->all_reduce(send, recv, count, Dtype(dtype), ROp(op), name);
     });
@@ -88,6 +91,7 @@ int kf_all_reduce(kf_peer *p, const void *send, void *recv, int64_t count,
 
 int kf_reduce(kf_peer *p, const void *send, void *recv, int64_t count,
               int dtype, int op, int root, const char *name) {
+    TraceScope trace(Tracer::COLLECTIVE);
     return with_session(p, [&](Session *s) {
         return s->reduce(send, recv, count, Dtype(dtype), ROp(op), root,
                          name);
@@ -96,6 +100,7 @@ int kf_reduce(kf_peer *p, const void *send, void *recv, int64_t count,
 
 int kf_broadcast(kf_peer *p, const void *send, void *recv, int64_t count,
                  int dtype, int root, const char *name) {
+    TraceScope trace(Tracer::COLLECTIVE);
     return with_session(p, [&](Session *s) {
         return s->broadcast(send, recv, count, Dtype(dtype), root, name);
     });
@@ -103,6 +108,7 @@ int kf_broadcast(kf_peer *p, const void *send, void *recv, int64_t count,
 
 int kf_gather(kf_peer *p, const void *send, int64_t count, void *recv,
               int64_t total_count, int dtype, int root, const char *name) {
+    TraceScope trace(Tracer::COLLECTIVE);
     return with_session(p, [&](Session *s) {
         return s->gather(send, count, recv, total_count, Dtype(dtype), root,
                          name);
@@ -111,6 +117,7 @@ int kf_gather(kf_peer *p, const void *send, int64_t count, void *recv,
 
 int kf_all_gather(kf_peer *p, const void *send, int64_t count, void *recv,
                   int dtype, const char *name) {
+    TraceScope trace(Tracer::COLLECTIVE);
     return with_session(p, [&](Session *s) {
         return s->all_gather(send, count, recv, Dtype(dtype), name);
     });
@@ -260,6 +267,15 @@ int kf_simd_enabled(int dtype) {
                ? 1
                : 0;
 }
+
+int64_t kf_trace_report(char *buf, int64_t cap) {
+    if (!buf || cap <= 0) return 0;
+    return int64_t(Tracer::instance().report(buf, size_t(cap)));
+}
+
+void kf_trace_reset(void) { Tracer::instance().reset(); }
+
+int kf_trace_enabled(void) { return Tracer::instance().enabled() ? 1 : 0; }
 
 const char *kf_version_string(void) { return "libkf 0.1.1 (kungfu-tpu)"; }
 

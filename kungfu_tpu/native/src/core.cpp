@@ -1,5 +1,7 @@
 #include "core.hpp"
 
+#include "trace.hpp"
+
 #include <cstdarg>
 #include <cstdlib>
 #include <ctime>
@@ -107,6 +109,7 @@ void accumulate_16bit_float(uint16_t *dst, const uint16_t *src, int64_t n,
 
 void reduce_accumulate(void *dst, const void *src, int64_t count, Dtype dt,
                        ROp op) {
+    TraceScope trace(Tracer::ACCUMULATE);
     if (reduce_accumulate_simd(dst, src, count, dt, op)) return;
     reduce_accumulate_scalar(dst, src, count, dt, op);
 }

@@ -1,5 +1,7 @@
 #include "transport.hpp"
 
+#include "trace.hpp"
+
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -290,6 +292,7 @@ void Rendezvous::commit_recv(RecvSlot *slot, bool ok) {
 int Rendezvous::pop_into(const PeerID &src, const std::string &name,
                          void *buf, size_t cap, size_t *len,
                          int64_t timeout_ms) {
+    TraceScope trace(Tracer::RECV_WAIT);
     const std::string key = rdv_key(src, name);
     const bool stall_log = std::getenv("KF_STALL_DETECTION") != nullptr;
     const auto t0 = std::chrono::steady_clock::now();
@@ -312,6 +315,9 @@ int Rendezvous::pop_into(const PeerID &src, const std::string &name,
             BufferPool::instance().put(std::move(msg));
             return KF_OK;
         }
+        // nothing queued and the sender's conn died mid-epoch: this
+        // receive can never be satisfied
+        if (dead_.count(src.str())) return KF_ERR_CONN;
         slots_[key].push_back(&slot);
         registered = true;
     }
@@ -387,9 +393,40 @@ int Rendezvous::pop(const PeerID &src, const std::string &name,
     }
 }
 
+void Rendezvous::conn_opened(const PeerID &src) {
+    std::lock_guard<std::mutex> lk(mu_);
+    live_conns_[src.str()]++;
+    // the peer is demonstrably alive (again): lift any death mark
+    dead_.erase(src.str());
+}
+
+void Rendezvous::conn_lost(const PeerID &src, bool may_fail) {
+    const std::string key = src.str();
+    const std::string prefix = key + "|";
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = live_conns_.find(key);
+    if (it != live_conns_.end()) {
+        if (--it->second > 0) return;  // a newer conn from src is live
+        live_conns_.erase(it);
+    }
+    if (!may_fail) return;  // epoch-switch close or server shutdown
+    dead_.insert(key);
+    for (auto sit = slots_.begin(); sit != slots_.end();) {
+        if (sit->first.compare(0, prefix.size(), prefix) != 0) {
+            ++sit;
+            continue;
+        }
+        for (RecvSlot *s : sit->second)
+            if (s->state == RecvSlot::waiting) s->state = RecvSlot::failed;
+        sit = slots_.erase(sit);
+    }
+    cv_.notify_all();
+}
+
 void Rendezvous::clear() {
     std::lock_guard<std::mutex> lk(mu_);
     q_.clear();
+    dead_.clear();
     // fail every waiting registration so blocked receivers fail fast at an
     // epoch switch instead of timing out; claimed slots are mid-write and
     // resolve via the reader's commit_recv
@@ -496,6 +533,7 @@ int Client::dial_fd(const PeerID &dest) {
 }
 
 int Client::dial(const PeerID &dest, ConnType t) {
+    TraceScope trace(Tracer::DIAL);
     int fd = dial_fd(dest);
     if (fd < 0) return fd;
     ConnHeader h{uint16_t(t), self_.port, self_.ipv4};
@@ -524,7 +562,14 @@ int Client::ensure_connected(Conn *c, const PeerID &dest, ConnType t) {
     if (c->fd >= 0) return KF_OK;
     int last = KF_ERR_CONN;
     int epoch_misses = 0;
-    for (int i = 0; i <= connect_retries; i++) {
+    // full dial patience is for peers still BOOTING; a peer this conn
+    // already reached and then lost has died mid-epoch, and senders must
+    // fail fast like receivers do (Rendezvous::fail_peer), not burn the
+    // whole patience budget (reference: bounded reconnect,
+    // connection.go:81-87)
+    const int budget = c->was_connected ? reconnect_retries
+                                        : connect_retries;
+    for (int i = 0; i <= budget; i++) {
         last = dial(dest, t);
         if (last >= 0) break;
         // KF_ERR_EPOCH gets a short retry budget of its own: during a
@@ -543,11 +588,13 @@ int Client::ensure_connected(Conn *c, const PeerID &dest, ConnType t) {
     }
     if (last < 0) return last;
     c->fd = last;
+    c->was_connected = true;
     return KF_OK;
 }
 
 int Client::send(const PeerID &dest, ConnType t, const std::string &name,
                  uint32_t flags, const void *data, size_t len) {
+    TraceScope trace(Tracer::SEND);
     auto c = get(dest, t);
     std::lock_guard<std::mutex> lk(c->mu);
     // a pooled fd may have been kicked by the peer's epoch switch: one
@@ -749,36 +796,47 @@ void Server::serve_conn(int fd) {
     const PeerID src{h.src_ipv4, h.src_port};
     const auto t = ConnType(h.type);
     if (t == ConnType::collective) {
+        rdv_->conn_opened(src);
         // collective fast path: after the header, ask the rendezvous for a
         // registered buffer so the body lands in-place (zero-copy); else
         // read into a pooled vector and queue it
-        while (running_) {
-            uint32_t name_len;
-            if (!read_exact(fd, &name_len, 4)) return;
-            if (name_len > 4096) return;
-            std::string name(name_len, '\0');
-            if (name_len && !read_exact(fd, name.data(), name_len)) return;
-            uint32_t flags, len;
-            if (!read_exact(fd, &flags, 4)) return;
-            if (!read_exact(fd, &len, 4)) return;
-            counters_->ingress += len;
-            const int64_t stall = body_stall_ms();
-            if (auto *slot = rdv_->begin_recv(src, name, len)) {
-                const bool ok =
-                    len == 0 ||
-                    read_exact_progress(fd, slot->buf, len, stall);
-                rdv_->commit_recv(slot, ok);
-                if (!ok) return;
-                continue;
+        [&] {
+            while (running_) {
+                uint32_t name_len;
+                if (!read_exact(fd, &name_len, 4)) return;
+                if (name_len > 4096) return;
+                std::string name(name_len, '\0');
+                if (name_len && !read_exact(fd, name.data(), name_len))
+                    return;
+                uint32_t flags, len;
+                if (!read_exact(fd, &flags, 4)) return;
+                if (!read_exact(fd, &len, 4)) return;
+                counters_->ingress += len;
+                const int64_t stall = body_stall_ms();
+                if (auto *slot = rdv_->begin_recv(src, name, len)) {
+                    const bool ok =
+                        len == 0 ||
+                        read_exact_progress(fd, slot->buf, len, stall);
+                    rdv_->commit_recv(slot, ok);
+                    if (!ok) return;
+                    continue;
+                }
+                WireMessage msg;
+                msg.name = std::move(name);
+                msg.flags = flags;
+                msg.data = BufferPool::instance().get(len);
+                if (len &&
+                    !read_exact_progress(fd, msg.data.data(), len, stall))
+                    return;
+                rdv_->push(src, std::move(msg));
             }
-            WireMessage msg;
-            msg.name = std::move(name);
-            msg.flags = flags;
-            msg.data = BufferPool::instance().get(len);
-            if (len && !read_exact_progress(fd, msg.data.data(), len, stall))
-                return;
-            rdv_->push(src, std::move(msg));
-        }
+        }();
+        // EOF/error on the sender's LAST live-epoch collective conn means
+        // it died mid-epoch (a graceful epoch switch bumps the token
+        // BEFORE conns drop, making ack.token stale here): fail its
+        // waiting receivers now instead of letting them block out their
+        // timeout
+        rdv_->conn_lost(src, running_ && token_.load() == ack.token);
         return;
     }
     WireMessage msg;

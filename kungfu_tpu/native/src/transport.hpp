@@ -134,12 +134,26 @@ class Rendezvous {
     void commit_recv(RecvSlot *slot, bool ok);
     // Drops queued messages and fails all waiting slots (epoch switch).
     void clear();
+    // Inbound collective-connection lifecycle, driving peer liveness:
+    // when a peer's LAST live conn is lost mid-epoch (may_fail=true, i.e.
+    // not an epoch-switch close), the peer is marked dead and every
+    // waiting slot registered against it fails — receivers get
+    // KF_ERR_CONN immediately instead of blocking out their full timeout
+    // (the fail-fast the reference's runner gets from watch.go:136-149
+    // process supervision). Queued messages are kept: data that already
+    // arrived is still valid. The live-conn count makes a same-epoch
+    // client re-dial race harmless: the old conn's EOF is a no-op while
+    // the newer conn is open, and a fresh conn lifts any death mark.
+    void conn_opened(const PeerID &src);
+    void conn_lost(const PeerID &src, bool may_fail);
 
   private:
     std::mutex mu_;
     std::condition_variable cv_;
     std::unordered_map<std::string, std::deque<std::vector<uint8_t>>> q_;
     std::unordered_map<std::string, std::deque<RecvSlot *>> slots_;
+    std::unordered_set<std::string> dead_;  // peers whose conn died mid-epoch
+    std::unordered_map<std::string, int> live_conns_;  // inbound, per peer
 };
 
 // ------------------------------------------------------------------ store
@@ -203,11 +217,14 @@ class Client {
                                     // convergence window), then fail fast
     int connect_retries = 120;      // x period = dial patience for peers
     int connect_retry_ms = 250;     // that are still starting up
+    int reconnect_retries = 6;      // budget once a peer was reached and
+                                    // lost: died mid-epoch => fail fast
 
   private:
     struct Conn {
         std::mutex mu;
         int fd = -1;
+        bool was_connected = false;  // ever reached: lost => short retries
     };
     std::shared_ptr<Conn> get(const PeerID &dest, ConnType t);
     int dial(const PeerID &dest, ConnType t);  // returns fd or negative err

@@ -1,0 +1,97 @@
+"""Failure injection: mid-collective death and stale-epoch fencing.
+
+VERDICT r1 Next #9. Scenario 1: a worker dies abruptly mid-epoch; the
+survivors' blocked receives must fail fast with KF_ERR_CONN (transport
+fail_peer on collective-conn EOF) instead of blocking out their full
+timeout (reference analog: runner fail-fast, watch.go:136-149, plus
+connection.go:81-87 conn-level errors). Scenario 2: a peer evicted by an
+epoch switch keeps sending; the token fence rejects it with
+KF_ERR_EPOCH, observable from Python.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.ffi import KF_ERR_EPOCH, KfError, NativePeer
+
+from test_control_plane import alloc_ports
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "workers",
+                      "fake_mid_collective_crash.py")
+
+
+def test_mid_collective_crash_fails_fast():
+    ports = alloc_ports(3)
+    spec = ",".join(f"127.0.0.1:{p}" for p in ports)
+    env = dict(os.environ)
+    env["KF_REPO"] = REPO
+    env["KF_LOG_LEVEL"] = "error"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(r), f"127.0.0.1:{ports[r]}", spec],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for r in range(3)
+    ]
+    t0 = time.perf_counter()
+    outs = {}
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=60)
+        outs[r] = (p.returncode, out)
+    wall = time.perf_counter() - t0
+    assert outs[2][0] == 17, outs  # the injected crash
+    for r in (0, 1):
+        rc, out = outs[r]
+        assert rc == 0, (r, rc, out, outs)
+        assert "failed fast=True" in out, (r, out)
+    # the whole run must beat the 30s collective timeout by a wide margin
+    assert wall < 20, (wall, outs)
+
+
+def test_stale_epoch_sender_rejected():
+    ports = alloc_ports(2)
+    spec = ",".join(f"127.0.0.1:{p}" for p in ports)
+    peers = [NativePeer(f"127.0.0.1:{p}", spec, version=0, strategy="RING",
+                        timeout_ms=20000) for p in ports]
+    for p in peers:
+        p.start()
+    try:
+        # warm epoch 0: both in, conns established
+        results = [None, None]
+
+        def warm(i):
+            results[i] = peers[i].all_reduce(np.ones(4, np.float32),
+                                             name="warm")
+
+        ts = [threading.Thread(target=warm, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results[0][0] == 2.0
+
+        # peer 0 moves to epoch 1 with peer 1 evicted
+        peers[0].update(f"127.0.0.1:{ports[0]}", version=1)
+        assert peers[0].version == 1
+
+        # the evicted peer keeps using its stale epoch: the token fence
+        # must reject it (KF_ERR_EPOCH), not hang or silently deliver
+        t0 = time.perf_counter()
+        with pytest.raises(KfError) as ei:
+            peers[1].all_reduce(np.ones(4, np.float32), name="stale")
+        assert ei.value.code == KF_ERR_EPOCH, str(ei.value)
+        assert time.perf_counter() - t0 < 15
+
+        # the survivor's new epoch still works (single-peer degenerate)
+        out = peers[0].all_reduce(np.ones(4, np.float32), name="post")
+        assert out[0] == 1.0
+    finally:
+        for p in peers:
+            p.close()

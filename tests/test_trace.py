@@ -1,0 +1,72 @@
+"""Tracing subsystem: scoped hot-path timers gated by KF_TRACE.
+
+VERDICT r1 Next #10 (reference: TRACE_SCOPE,
+srcs/cpp/include/kungfu/utils/trace.hpp:1-16). The enable flag is
+latched at libkf's first check, so the enabled-path test runs in a
+subprocess with KF_TRACE=1 in its environment.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DRIVER = textwrap.dedent("""
+    import json, os, threading
+    import numpy as np
+    from kungfu_tpu.ffi import (NativePeer, trace_enabled, trace_report,
+                                trace_reset)
+    ports = [int(p) for p in os.environ["KF_TEST_PORTS"].split(",")]
+    spec = ",".join(f"127.0.0.1:{p}" for p in ports)
+    peers = [NativePeer(f"127.0.0.1:{p}", spec, version=0, strategy="RING",
+                        timeout_ms=15000) for p in ports]
+    for p in peers:
+        p.start()
+    def work(p):
+        p.all_reduce(np.ones(1 << 18, np.float32), name="t")
+    ts = [threading.Thread(target=work, args=(p,)) for p in peers]
+    for t in ts: t.start()
+    for t in ts: t.join()
+    print(json.dumps({"enabled": trace_enabled(), "report": trace_report()}))
+    trace_reset()
+    print(json.dumps({"after_reset": trace_report()}))
+    for p in peers:
+        p.close()
+""")
+
+
+def _run(extra_env):
+    from test_control_plane import alloc_ports
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["KF_LOG_LEVEL"] = "error"
+    env["KF_TEST_PORTS"] = ",".join(str(p) for p in alloc_ports(2))
+    env.update(extra_env)
+    out = subprocess.run([sys.executable, "-c", DRIVER], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    import json
+
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    return [json.loads(l) for l in lines]
+
+
+def test_trace_enabled_records_hot_paths():
+    first, second = _run({"KF_TRACE": "1"})
+    assert first["enabled"]
+    report = first["report"]
+    # every hot path fired during a 2-peer ring all-reduce
+    for scope in ("send", "dial", "recv_wait", "accumulate", "collective"):
+        assert report[scope]["count"] > 0, (scope, report)
+        assert report[scope]["total_us"] >= 0
+        assert report[scope]["max_us"] <= report[scope]["total_us"]
+    assert second["after_reset"] == {}
+
+
+def test_trace_disabled_is_empty():
+    first, _ = _run({"KF_TRACE": ""})  # empty counts as off
+    assert not first["enabled"]
+    assert first["report"] == {}

@@ -1,0 +1,52 @@
+"""Worker for the mid-collective failure-injection test.
+
+Three ranks form a cluster and run one warm all-reduce (establishing
+every collective connection). Rank 2 then dies abruptly (os._exit — no
+graceful close, like a OOM-killed or segfaulted worker). Ranks 0/1 run a
+second all-reduce with a LONG timeout and must get KF_ERR_CONN fast (the
+fail_peer path), not block out the timeout (reference analog: watch.go:
+136-149 fail-fast supervision; here the transport itself fails fast).
+
+argv: rank self_spec peer_spec
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.environ.get("KF_REPO", "/root/repo"))
+
+from kungfu_tpu.ffi import KF_ERR_CONN, KfError, NativePeer  # noqa: E402
+
+rank = int(sys.argv[1])
+self_spec, peer_spec = sys.argv[2], sys.argv[3]
+TIMEOUT_MS = 30000
+
+p = NativePeer(self_spec, peer_spec, version=0, strategy="RING",
+               timeout_ms=TIMEOUT_MS)
+p.start()
+
+warm = p.all_reduce(np.ones(8, np.float32), name="warm")
+assert warm[0] == 3.0, warm
+print(f"rank {rank} warm ok", flush=True)
+
+if rank == 2:
+    sys.stdout.flush()
+    os._exit(17)  # die without closing anything gracefully
+
+time.sleep(1.0)  # let rank 2's death reach our server as an EOF
+t0 = time.perf_counter()
+rc = 3
+try:
+    p.all_reduce(np.ones(8, np.float32), name="after-crash")
+    print(f"rank {rank} UNEXPECTED success", flush=True)
+except KfError as e:
+    elapsed = time.perf_counter() - t0
+    fast = elapsed < TIMEOUT_MS / 1000.0 / 2
+    print(f"rank {rank} failed fast={fast} in {elapsed * 1e3:.0f} ms "
+          f"code={e.code} ({e})", flush=True)
+    rc = 0 if (fast and e.code == KF_ERR_CONN) else 4
+# skip p.close(): the cluster is torn, a graceful goodbye may block
+os._exit(rc)
