@@ -26,6 +26,10 @@ struct ConnHeader {
     uint16_t type;
     uint16_t src_port;
     uint32_t src_ipv4;
+    // the dialer's epoch token: lets the server separate a stale-epoch
+    // re-dial (peer alive, mid-resize — not a death signal) from a
+    // same-epoch conn whose loss means the peer died
+    uint32_t token;
 } __attribute__((packed));
 
 struct Ack {
@@ -362,37 +366,6 @@ int Rendezvous::pop_into(const PeerID &src, const std::string &name,
     }
 }
 
-int Rendezvous::pop(const PeerID &src, const std::string &name,
-                    std::vector<uint8_t> *out, int64_t timeout_ms) {
-    const std::string key = rdv_key(src, name);
-    const bool stall_log = std::getenv("KF_STALL_DETECTION") != nullptr;
-    const auto t0 = std::chrono::steady_clock::now();
-    const auto deadline = t0 + std::chrono::milliseconds(timeout_ms);
-    auto next_stall_report = t0 + std::chrono::seconds(3);
-    std::unique_lock<std::mutex> lk(mu_);
-    for (;;) {
-        auto it = q_.find(key);
-        if (it != q_.end() && !it->second.empty()) {
-            *out = std::move(it->second.front());
-            it->second.pop_front();
-            if (it->second.empty()) q_.erase(it);
-            return KF_OK;
-        }
-        const auto now = std::chrono::steady_clock::now();
-        if (timeout_ms > 0 && now >= deadline) return KF_ERR_TIMEOUT;
-        if (stall_log && now >= next_stall_report) {
-            KF_WARN("recv of %s stalled for %lds", key.c_str(),
-                    long(std::chrono::duration_cast<std::chrono::seconds>(
-                             now - t0)
-                             .count()));
-            next_stall_report = now + std::chrono::seconds(3);
-        }
-        auto wake = now + std::chrono::seconds(3);  // stall-report tick
-        if (timeout_ms > 0 && deadline < wake) wake = deadline;
-        cv_.wait_until(lk, wake);
-    }
-}
-
 void Rendezvous::conn_opened(const PeerID &src) {
     std::lock_guard<std::mutex> lk(mu_);
     live_conns_[src.str()]++;
@@ -536,7 +509,7 @@ int Client::dial(const PeerID &dest, ConnType t) {
     TraceScope trace(Tracer::DIAL);
     int fd = dial_fd(dest);
     if (fd < 0) return fd;
-    ConnHeader h{uint16_t(t), self_.port, self_.ipv4};
+    ConnHeader h{uint16_t(t), self_.port, self_.ipv4, token_.load()};
     Ack ack{};
     if (!write_exact(fd, &h, sizeof(h)) || !read_exact(fd, &ack, sizeof(ack))) {
         ::close(fd);
@@ -686,9 +659,21 @@ int Server::start() {
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(self_.port);
-    addr.sin_addr.s_addr = htonl(INADDR_ANY);
-    if (::bind(listen_fd_, (sockaddr *)&addr, sizeof(addr)) != 0 ||
-        ::listen(listen_fd_, 128) != 0) {
+    // bind the peer's OWN address, not INADDR_ANY: the peer list defines
+    // where this worker is reachable, and per-IP binding lets several
+    // emulated hosts (loopback aliases) share a port range on one
+    // machine the way distinct pod hosts do. NAT'd workers (container
+    // addressed by a host IP no local interface carries) get
+    // EADDRNOTAVAIL here — fall back to wildcard for them.
+    addr.sin_addr.s_addr = htonl(self_.ipv4);
+    int rc = ::bind(listen_fd_, (sockaddr *)&addr, sizeof(addr));
+    if (rc != 0 && errno == EADDRNOTAVAIL) {
+        KF_WARN("%s is not a local address (NAT?); listening on wildcard",
+                self_.str().c_str());
+        addr.sin_addr.s_addr = htonl(INADDR_ANY);
+        rc = ::bind(listen_fd_, (sockaddr *)&addr, sizeof(addr));
+    }
+    if (rc != 0 || ::listen(listen_fd_, 128) != 0) {
         KF_ERROR("bind/listen failed on %s: %s", self_.str().c_str(),
                  std::strerror(errno));
         ::close(listen_fd_);
@@ -796,7 +781,11 @@ void Server::serve_conn(int fd) {
     const PeerID src{h.src_ipv4, h.src_port};
     const auto t = ConnType(h.type);
     if (t == ConnType::collective) {
-        rdv_->conn_opened(src);
+        // a stale-epoch dial (mid-resize laggard) is not a liveness
+        // signal either way: its EOF is the dialer noticing our ack's
+        // token mismatch, not a death — keep it out of the accounting
+        const bool same_epoch = h.token == ack.token;
+        if (same_epoch) rdv_->conn_opened(src);
         // collective fast path: after the header, ask the rendezvous for a
         // registered buffer so the body lands in-place (zero-copy); else
         // read into a pooled vector and queue it
@@ -831,12 +820,13 @@ void Server::serve_conn(int fd) {
                 rdv_->push(src, std::move(msg));
             }
         }();
-        // EOF/error on the sender's LAST live-epoch collective conn means
+        // EOF/error on the sender's LAST same-epoch collective conn means
         // it died mid-epoch (a graceful epoch switch bumps the token
         // BEFORE conns drop, making ack.token stale here): fail its
         // waiting receivers now instead of letting them block out their
         // timeout
-        rdv_->conn_lost(src, running_ && token_.load() == ack.token);
+        if (same_epoch)
+            rdv_->conn_lost(src, running_ && token_.load() == ack.token);
         return;
     }
     WireMessage msg;

@@ -117,9 +117,6 @@ class Rendezvous {
     };
 
     void push(const PeerID &src, WireMessage msg);
-    // Blocks until a message for (src,name) arrives; KF_OK / KF_ERR_TIMEOUT.
-    int pop(const PeerID &src, const std::string &name,
-            std::vector<uint8_t> *out, int64_t timeout_ms);
     // In-place receive into caller memory. Takes an already-queued message
     // if present (recycling its buffer), else registers `buf` so the reader
     // thread fills it directly. Fails with KF_ERR if the message is larger

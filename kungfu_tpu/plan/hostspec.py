@@ -42,6 +42,19 @@ DEFAULT_PORT_RANGE = PortRange(10000, 11000)
 DEFAULT_RUNNER_PORT = 38080
 
 
+def split_host_entry(spec: str) -> "tuple[str, int, str]":
+    """'host[:slots[:public]]' -> (host, slots, public). The single
+    grammar for -H entries; `host` may still be a hostname here (the
+    runner's discovery layer resolves it, reference: discovery.go:195)."""
+    parts = spec.split(":")
+    if not parts or not parts[0] or len(parts) > 3:
+        raise ValueError(f"invalid host spec: {spec!r}")
+    host = parts[0]
+    slots = int(parts[1]) if len(parts) >= 2 else 1
+    public = parts[2] if len(parts) == 3 else host
+    return host, slots, public
+
+
 @dataclass(frozen=True)
 class HostSpec:
     ipv4: int
@@ -50,17 +63,8 @@ class HostSpec:
 
     @classmethod
     def parse(cls, spec: str) -> "HostSpec":
-        parts = spec.split(":")
-        if not parts or not parts[0]:
-            raise ValueError(f"invalid host spec: {spec!r}")
-        ipv4 = parse_ipv4(parts[0])
-        if len(parts) == 1:
-            return cls(ipv4, 1, parts[0])
-        if len(parts) == 2:
-            return cls(ipv4, int(parts[1]), parts[0])
-        if len(parts) == 3:
-            return cls(ipv4, int(parts[1]), parts[2])
-        raise ValueError(f"invalid host spec: {spec!r}")
+        host, slots, public = split_host_entry(spec)
+        return cls(parse_ipv4(host), slots, public)
 
     def __str__(self) -> str:
         return f"{format_ipv4(self.ipv4)}:{self.slots}:{self.public_addr}"

@@ -8,7 +8,6 @@ mode), -config-server, -logdir, -q, -keep, -timeout-ms.
 from __future__ import annotations
 
 import argparse
-import socket
 import sys
 import urllib.error
 
@@ -26,23 +25,25 @@ from .watch import simple_run, watch_run
 def infer_self_ipv4() -> str:
     """Best-effort local IP discovery (reference: runner/discovery.go).
     Single-host and loopback-cluster runs just use 127.0.0.1."""
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect(("8.8.8.8", 80))
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
-    except OSError:
-        return "127.0.0.1"
+    from ..plan import format_ipv4
+
+    from .discovery import default_route_ipv4
+
+    ip = default_route_ipv4()
+    return format_ipv4(ip) if ip is not None else "127.0.0.1"
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kfrun", description=__doc__)
     ap.add_argument("-np", type=int, default=1, help="total workers")
     ap.add_argument("-H", dest="hosts", default="",
-                    help="host list ip:slots[:pub],...")
+                    help="host list host:slots[:pub],... (hostnames are "
+                         "DNS-resolved, scoped to -nic's subnet)")
     ap.add_argument("-self", dest="self_ip", default="",
                     help="this runner's IPv4")
+    ap.add_argument("-nic", default="",
+                    help="network interface of the cluster fabric "
+                         "(scopes hostname resolution + self detection)")
     ap.add_argument("-port-range", dest="port_range", default="10000-11000")
     ap.add_argument("-strategy", default="AUTO")
     ap.add_argument("-w", dest="watch", action="store_true",
@@ -65,26 +66,53 @@ def main(argv=None) -> int:
     if not prog:
         ap.error("no program given (use: kfrun [flags] -- prog args)")
 
-    hosts = HostList.parse(args.hosts) if args.hosts else None
+    from .discovery import nic_ipv4_net, resolve_host_list
+
+    if args.nic:
+        try:
+            nic_ipv4_net(args.nic)
+        except OSError as e:
+            print(f"[kfrun] bad -nic {args.nic!r}: {e}", file=sys.stderr)
+            return 2
+    try:
+        hosts = resolve_host_list(args.hosts, args.nic) \
+            if args.hosts else None
+    except ValueError as e:
+        print(f"[kfrun] bad -H: {e}", file=sys.stderr)
+        return 2
     if args.self_ip:
         self_ip = args.self_ip
     elif hosts is None:
         self_ip = "127.0.0.1"
     else:
-        # pick the host-list entry this machine matches: inferred NIC IP
-        # if listed, else loopback if listed, else (single-host list) that
-        # host — multi-host lists require -self to disambiguate
-        from ..plan import parse_ipv4
+        # pick the host-list entry this machine matches: any local NIC
+        # address that is listed, else loopback if listed, else
+        # (single-host list) that host — otherwise require -self
+        from ..plan import format_ipv4, parse_ipv4
+
+        from .discovery import in_subnet, list_nics
 
         host_ips = {h.ipv4 for h in hosts}
-        inferred = infer_self_ipv4()
-        if parse_ipv4(inferred) in host_ips:
-            self_ip = inferred
+        loopback_net = (parse_ipv4("127.0.0.0"), parse_ipv4("255.0.0.0"))
+        nics = [args.nic] if args.nic else list_nics()
+        local = []
+        for nic in nics:
+            try:
+                local.append(nic_ipv4_net(nic)[0])
+            except OSError:
+                pass
+        # fabric addresses first; loopback only as the explicit fallback
+        # (lo is first in if_nameindex and must not shadow the real NIC)
+        matches = [ip for ip in local
+                   if ip in host_ips and not in_subnet(ip, *loopback_net)]
+        if matches:
+            self_ip = format_ipv4(matches[0])
         elif parse_ipv4("127.0.0.1") in host_ips:
             self_ip = "127.0.0.1"
         elif len(hosts) == 1:
-            self_ip = hosts[0].public_addr
+            self_ip = format_ipv4(hosts[0].ipv4)
         else:
+            inferred = infer_self_ipv4()
             print(
                 f"[kfrun] cannot tell which of {args.hosts} is this host "
                 f"(inferred {inferred}); pass -self",

@@ -1,0 +1,129 @@
+"""Host discovery: NIC subnets, DNS-resolved -H, HTTP self-resolve.
+
+VERDICT r1 Missing #6 (reference: srcs/go/kungfu/runner/
+discovery.go:157-306). Everything runs offline: `localhost` resolves
+through /etc/hosts, `lo` always exists on Linux, and the self-resolve
+handshake runs between two loopback "hosts" on distinct ports.
+"""
+
+import threading
+
+import pytest
+
+from kungfu_tpu.plan import format_ipv4, parse_ipv4
+from kungfu_tpu.run.discovery import (
+    in_subnet,
+    list_nics,
+    nic_ipv4_net,
+    parse_host_entry,
+    resolve_host_list,
+    resolve_ipv4,
+    resolve_peers_via_http,
+)
+
+from test_control_plane import alloc_ports
+
+LOOPBACK_NET = (parse_ipv4("127.0.0.1"), parse_ipv4("255.0.0.0"))
+
+
+class TestNic:
+    def test_loopback_exists(self):
+        assert "lo" in list_nics()
+        addr, mask = nic_ipv4_net("lo")
+        assert format_ipv4(addr) == "127.0.0.1"
+        assert format_ipv4(mask) == "255.0.0.0"
+
+    def test_unknown_nic_raises(self):
+        with pytest.raises(OSError):
+            nic_ipv4_net("definitely-not-a-nic0")
+
+
+class TestResolve:
+    def test_literal_ipv4_passthrough(self):
+        assert format_ipv4(resolve_ipv4("10.1.2.3")) == "10.1.2.3"
+
+    def test_hostname_via_etc_hosts(self):
+        assert format_ipv4(resolve_ipv4("localhost")) == "127.0.0.1"
+
+    def test_subnet_filter_accepts(self):
+        assert resolve_ipv4("localhost", LOOPBACK_NET) == \
+            parse_ipv4("127.0.0.1")
+
+    def test_subnet_filter_rejects(self):
+        wrong = (parse_ipv4("10.0.0.0"), parse_ipv4("255.0.0.0"))
+        with pytest.raises(ValueError, match="0 addresses"):
+            resolve_ipv4("localhost", wrong)
+
+    def test_unresolvable_hostname(self):
+        with pytest.raises(ValueError, match="cannot resolve"):
+            resolve_ipv4("no-such-host.invalid")
+
+    def test_in_subnet(self):
+        assert in_subnet(parse_ipv4("127.9.9.9"), *LOOPBACK_NET)
+        assert not in_subnet(parse_ipv4("10.0.0.1"), *LOOPBACK_NET)
+
+
+class TestHostList:
+    def test_entry_forms(self):
+        assert parse_host_entry("node-a") == ("node-a", 1, "node-a")
+        assert parse_host_entry("node-a:4") == ("node-a", 4, "node-a")
+        assert parse_host_entry("node-a:4:pub") == ("node-a", 4, "pub")
+        with pytest.raises(ValueError):
+            parse_host_entry("a:1:b:c")
+
+    def test_pure_ipv4_matches_plain_parse(self):
+        spec = "127.0.0.1:2,127.0.0.2:3:pub2"
+        from kungfu_tpu.plan import HostList
+
+        assert resolve_host_list(spec) == HostList.parse(spec)
+
+    def test_hostname_entries_resolved(self):
+        hl = resolve_host_list("localhost:2,127.0.0.2:1")
+        assert [format_ipv4(h.ipv4) for h in hl] == \
+            ["127.0.0.1", "127.0.0.2"]
+        assert [h.slots for h in hl] == [2, 1]
+        # public addr keeps the name workers/ssh can reach
+        assert hl[0].public_addr == "localhost"
+
+    def test_bad_explicit_nic(self):
+        with pytest.raises(ValueError, match="bad -nic"):
+            resolve_host_list("localhost:1", nic="nope0")
+
+
+def test_http_self_resolve_two_runners():
+    """Two 'runners' on loopback learn each other's fabric IPv4 through
+    the /resolve handshake, keyed by reachable hostname."""
+    pa, pb = alloc_ports(2)
+    results = {}
+    errors = {}
+
+    def runner(name, my_ip, my_port, peers):
+        try:
+            # generous budget: the suite may be loading this 1-core host
+            results[name] = resolve_peers_via_http(
+                parse_ipv4(my_ip), my_port, peers, timeout_s=90)
+        except Exception as e:  # noqa: BLE001 — surfaced via assert below
+            errors[name] = e
+
+    ta = threading.Thread(
+        target=runner,
+        args=("a", "127.0.0.1", pa, [("localhost", pb)]))
+    tb = threading.Thread(
+        target=runner,
+        args=("b", "127.0.0.2", pb, [("localhost", pa)]))
+    ta.start()
+    tb.start()
+    ta.join(120)
+    tb.join(120)
+    assert not errors, errors
+    # each side learned the OTHER's canonical address, not DNS's view
+    assert results["a"] == {"localhost": parse_ipv4("127.0.0.2")}
+    assert results["b"] == {"localhost": parse_ipv4("127.0.0.1")}
+
+
+def test_http_self_resolve_timeout():
+    port, silent = alloc_ports(2)
+    with pytest.raises(TimeoutError, match="no answer"):
+        resolve_peers_via_http(parse_ipv4("127.0.0.1"), port,
+                               [("localhost", silent)],
+                               timeout_s=1.5, poll_s=0.1)
