@@ -1,29 +1,31 @@
-"""Shared helpers for the examples: synthetic MNIST + simple data loading.
+"""Shared helpers for the examples: MNIST loading via kungfu_tpu.datasets.
 
 The reference's examples download MNIST (reference:
-srcs/python/kungfu/tensorflow/v1/helpers/mnist.py); this environment has no
-egress, so examples default to a deterministic synthetic MNIST-shaped
-dataset (cluster-separated Gaussians, learnable to high accuracy) and use
-real MNIST from an .npz path when ``--data`` is given.
+srcs/python/kungfu/tensorflow/v1/helpers/mnist.py); this environment has
+no egress, so examples accept ``--data`` as either an .npz file, a
+directory of idx distribution files, or empty (deterministic synthetic
+MNIST-shaped data from ``kungfu_tpu.datasets``).
 """
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+from kungfu_tpu.datasets import load_mnist_split, load_synthetic_split
 
 
 def synthetic_mnist(n: int = 8192, seed: int = 0):
-    """(x, y): n 28x28 images in [0,1], 10 linearly separable-ish classes."""
-    rng = np.random.default_rng(seed)
-    y = rng.integers(0, 10, size=n)
-    centers = rng.normal(0.5, 0.5, size=(10, 28 * 28))
-    x = centers[y] + rng.normal(0.0, 0.35, size=(n, 28 * 28))
-    x = np.clip(x, 0.0, 1.0).astype(np.float32).reshape(n, 28, 28, 1)
-    return x, y.astype(np.int32)
+    ds = load_synthetic_split(n=n, seed=seed)
+    return ds.images, ds.labels
 
 
 def load_mnist(path: str = ""):
-    """Real MNIST from an npz with keys x_train/y_train, else synthetic."""
+    """(x, y) from an .npz, an idx directory, or synthetic fallback."""
+    if path and os.path.isdir(path):
+        ds = load_mnist_split(path, "train")
+        return ds.images, ds.labels
     if path:
         d = np.load(path)
         x = (d["x_train"].astype(np.float32) / 255.0)[..., None]

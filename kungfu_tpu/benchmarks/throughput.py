@@ -1,0 +1,122 @@
+"""Per-model SyncSGD training throughput (the reference's headline trio).
+
+The reference's sync-scalability plot benchmarks ResNet-50, VGG16 and
+InceptionV3 (reference: README.md:197-205, benchmarks/system/
+benchmark_kungfu.py methodology: synthetic ImageNet-shaped data, timed
+iterations, images/sec). `bench.py` is the driver-facing ResNet-50
+headline; this module measures any zoo model the same way:
+
+  python -m kungfu_tpu.benchmarks.throughput --model inception3
+  python -m kungfu_tpu.benchmarks.throughput --model vgg16 --batch 64
+
+Prints one JSON line per run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+MODELS = {
+    # name -> (constructor kwargs resolver, image size, default batch)
+    "resnet50": (lambda m: m.ResNet50(num_classes=1000), 224, 128),
+    "vgg16": (lambda m: m.VGG16(num_classes=1000), 224, 64),
+    "inception3": (lambda m: m.InceptionV3(num_classes=1000), 299, 64),
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=sorted(MODELS), default="resnet50")
+    ap.add_argument("--batch", type=int, default=0, help="per-chip batch")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import kungfu_tpu.models as models
+    from kungfu_tpu.optimizers import sync_sgd
+    from kungfu_tpu.parallel import (
+        build_train_step_with_state,
+        data_mesh,
+        init_worker_state,
+        replicate_to_workers,
+        shard_batch,
+    )
+
+    build, image, default_batch = MODELS[args.model]
+    n = jax.device_count()
+    platform = jax.devices()[0].platform
+    if platform == "cpu":  # keep the smoke path fast
+        image = 75 if args.model == "inception3" else 64
+        default_batch = 4
+        args.iters, args.warmup = min(args.iters, 3), 1
+    args.warmup = max(args.warmup, 1)  # the warmup fence binds `loss`
+    batch = args.batch or default_batch
+
+    mesh = data_mesh(n)
+    model = build(models)
+    x = jnp.ones((batch * n, image, image, 3), jnp.float32)
+    y = jnp.zeros((batch * n,), jnp.int32)
+    k0, k1 = jax.random.split(jax.random.PRNGKey(0))
+    # 'dropout' rng for VGG; harmless for BN models. A fixed key per step
+    # keeps the step a pure function of its state (throughput-only).
+    rngs = {"params": k0, "dropout": k1}
+    variables = model.init(rngs, x[:2], train=True)
+    has_bn = "batch_stats" in variables
+
+    def loss_fn(params, batch_stats, b):
+        coll = {"params": params}
+        if has_bn:
+            coll["batch_stats"] = batch_stats
+        logits, updated = model.apply(
+            coll, b["x"], train=True, mutable=["batch_stats"],
+            rngs={"dropout": k1},
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]).mean()
+        return loss, updated.get("batch_stats", batch_stats)
+
+    tx = sync_sgd(optax.sgd(0.1, momentum=0.9))
+    params_s = replicate_to_workers(variables["params"], mesh)
+    stats_s = replicate_to_workers(variables.get("batch_stats", {}), mesh)
+    opt_s = init_worker_state(tx, params_s, mesh)
+    step = build_train_step_with_state(loss_fn, tx, mesh)
+    batch_s = shard_batch({"x": x, "y": y}, mesh)
+
+    for _ in range(args.warmup):
+        params_s, stats_s, opt_s, loss = step(params_s, stats_s, opt_s,
+                                              batch_s)
+    float(loss)  # true execution fence (see bench.py note)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params_s, stats_s, opt_s, loss = step(params_s, stats_s, opt_s,
+                                              batch_s)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "NaN loss in benchmark"
+
+    per_chip = batch * n * args.iters / dt / n
+    print(json.dumps({
+        "metric": f"{args.model}_syncsgd_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "details": {
+            "platform": platform, "chips": n, "per_chip_batch": batch,
+            "image_size": image, "iters": args.iters, "dtype": "bfloat16",
+            "step_time_ms": round(1000 * dt / args.iters, 2),
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

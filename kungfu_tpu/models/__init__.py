@@ -12,6 +12,7 @@ stay in exact parity with the architectures.
 
 from .bert import BertConfig, BertEncoder
 from .fake_models import fake_model_catalog, model_param_sizes
+from .inception import InceptionV3
 from .mlp import MLP, SLP
 from .resnet import ResNet, ResNet18, ResNet50, ResNet101
 from .vgg import VGG16
@@ -24,6 +25,7 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "VGG16",
+    "InceptionV3",
     "BertConfig",
     "BertEncoder",
     "fake_model_catalog",

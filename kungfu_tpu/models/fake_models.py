@@ -20,7 +20,8 @@ import jax.numpy as jnp
 @lru_cache(maxsize=None)
 def model_param_sizes(name: str) -> List[Tuple[str, Tuple[int, ...]]]:
     """[(param_path, shape), ...] for a named catalog model."""
-    from . import MLP, SLP, BertConfig, BertEncoder, ResNet50, VGG16
+    from . import (MLP, SLP, BertConfig, BertEncoder, InceptionV3,
+                   ResNet50, VGG16)
 
     def shapes_of(module, sample):
         variables = jax.eval_shape(
@@ -38,6 +39,9 @@ def model_param_sizes(name: str) -> List[Tuple[str, Tuple[int, ...]]]:
         return shapes_of(ResNet50(num_classes=1000), img)
     if name == "vgg16-imagenet":
         return shapes_of(VGG16(num_classes=1000), img)
+    if name == "inception3-imagenet":
+        return shapes_of(InceptionV3(num_classes=1000),
+                         jnp.zeros((1, 299, 299, 3), jnp.float32))
     if name == "bert-base":
         cfg = BertConfig(num_layers=12)
         return shapes_of(BertEncoder(cfg),
@@ -49,8 +53,8 @@ def model_param_sizes(name: str) -> List[Tuple[str, Tuple[int, ...]]]:
     raise ValueError(f"unknown fake model: {name}")
 
 
-CATALOG = ["resnet50-imagenet", "vgg16-imagenet", "bert-base", "mlp-mnist",
-           "slp-mnist"]
+CATALOG = ["resnet50-imagenet", "vgg16-imagenet", "inception3-imagenet",
+           "bert-base", "mlp-mnist", "slp-mnist"]
 
 
 def fake_model_catalog(name: str, fuse: bool = False) -> Dict[str, int]:

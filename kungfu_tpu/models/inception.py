@@ -1,0 +1,176 @@
+"""InceptionV3 — third model of the reference's headline benchmark trio.
+
+The reference's sync-scalability plot benchmarks ResNet-50, VGG16 and
+InceptionV3 (reference: README.md:197-205, benchmarks/system/result/
+sync-scalability.svg, via tf.keras applications). TPU-first flax build:
+bfloat16 activations/weights with float32 BatchNorm statistics, NHWC,
+no Python control flow dependent on data — the same recipe as
+`models/resnet.py`. Architecture per "Rethinking the Inception
+Architecture" (Szegedy et al. 2015), 299x299 input, no aux head (the
+benchmarks train the main loss only).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    """conv -> BN -> relu, the basic Inception unit."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _maxpool(x, window, strides, padding="VALID"):
+    return nn.max_pool(x, (window, window), (strides, strides), padding)
+
+
+def _avgpool3(x):
+    return nn.avg_pool(x, (3, 3), (1, 1), "SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(64, (1, 1))(x, train)
+        b5 = conv(48, (1, 1))(x, train)
+        b5 = conv(64, (5, 5))(b5, train)
+        b3 = conv(64, (1, 1))(x, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        bp = conv(self.pool_features, (1, 1))(_avgpool3(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b3 = conv(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        bd = conv(64, (1, 1))(x, train)
+        bd = conv(96, (3, 3))(bd, train)
+        bd = conv(96, (3, 3), (2, 2), padding="VALID")(bd, train)
+        bp = _maxpool(x, 3, 2)
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches at 17x17."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = conv(192, (1, 1))(x, train)
+        b7 = conv(c7, (1, 1))(x, train)
+        b7 = conv(c7, (1, 7))(b7, train)
+        b7 = conv(192, (7, 1))(b7, train)
+        bd = conv(c7, (1, 1))(x, train)
+        bd = conv(c7, (7, 1))(bd, train)
+        bd = conv(c7, (1, 7))(bd, train)
+        bd = conv(c7, (7, 1))(bd, train)
+        bd = conv(192, (1, 7))(bd, train)
+        bp = conv(192, (1, 1))(_avgpool3(x), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b3 = conv(192, (1, 1))(x, train)
+        b3 = conv(320, (3, 3), (2, 2), padding="VALID")(b3, train)
+        b7 = conv(192, (1, 1))(x, train)
+        b7 = conv(192, (1, 7))(b7, train)
+        b7 = conv(192, (7, 1))(b7, train)
+        b7 = conv(192, (3, 3), (2, 2), padding="VALID")(b7, train)
+        bp = _maxpool(x, 3, 2)
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank blocks at 8x8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(320, (1, 1))(x, train)
+        b3 = conv(384, (1, 1))(x, train)
+        b3 = jnp.concatenate([conv(384, (1, 3))(b3, train),
+                              conv(384, (3, 1))(b3, train)], axis=-1)
+        bd = conv(448, (1, 1))(x, train)
+        bd = conv(384, (3, 3))(bd, train)
+        bd = jnp.concatenate([conv(384, (1, 3))(bd, train),
+                              conv(384, (3, 1))(bd, train)], axis=-1)
+        bp = conv(192, (1, 1))(_avgpool3(x), train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        x = jnp.asarray(x, self.dtype)
+        # stem: 299 -> 35x35x192
+        x = conv(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = conv(32, (3, 3), padding="VALID")(x, train)
+        x = conv(64, (3, 3))(x, train)
+        x = _maxpool(x, 3, 2)
+        x = conv(80, (1, 1), padding="VALID")(x, train)
+        x = conv(192, (3, 3), padding="VALID")(x, train)
+        x = _maxpool(x, 3, 2)
+        # 3x A (35x35) -> B -> 4x C (17x17) -> D -> 2x E (8x8)
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionB(self.dtype)(x, train)
+        x = InceptionC(128, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(192, self.dtype)(x, train)
+        x = InceptionD(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier in f32 for a numerically stable softmax
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

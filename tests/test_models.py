@@ -20,6 +20,7 @@ from kungfu_tpu.models import (
     SLP,
     BertConfig,
     BertEncoder,
+    InceptionV3,
     ResNet18,
     ResNet50,
     VGG16,
@@ -40,6 +41,12 @@ class TestCatalogs:
         c = fake_model_catalog("vgg16-imagenet")
         total = sum(c.values())
         assert 138e6 < total < 139e6  # VGG16 ~138.4M params
+
+    def test_inception3_catalog(self):
+        c = fake_model_catalog("inception3-imagenet")
+        total = sum(c.values())
+        # InceptionV3 (no aux head) ~23.8M params
+        assert 23.6e6 < total < 24.0e6
 
     def test_fuse_mode(self):
         full = fake_model_catalog("bert-base")
@@ -89,6 +96,16 @@ class TestBigModelShapes:
                 jnp.zeros((2, 224, 224, 3), jnp.float32),
                 train=False)[0])
         assert out.shape == (2, 1000)
+
+    def test_inception3_output_shape(self):
+        model = InceptionV3(num_classes=1000)
+        out = jax.eval_shape(
+            lambda: model.init_with_output(
+                jax.random.PRNGKey(0),
+                jnp.zeros((2, 299, 299, 3), jnp.float32),
+                train=False)[0])
+        assert out.shape == (2, 1000)
+        assert out.dtype == jnp.float32  # f32 head over bf16 trunk
 
     def test_bert_output_shape(self):
         cfg = BertConfig(num_layers=2)

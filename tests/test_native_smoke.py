@@ -1,0 +1,20 @@
+"""C++ in-proc smoke test: 4-peer cluster driven from native threads.
+
+SURVEY §5.2: the rebuild adds race detection the reference lacked.
+`make test` runs the plain build here; `make -C kungfu_tpu/native
+tsan-test` runs the same scenario under ThreadSanitizer (exercised in
+round-2 development; too slow for every pytest run).
+"""
+
+import os
+import subprocess
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "kungfu_tpu", "native")
+
+
+def test_cpp_smoke():
+    r = subprocess.run(["make", "-C", NATIVE, "test"], timeout=300,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:])
+    assert "smoke ok" in r.stdout
