@@ -21,7 +21,9 @@ import time
 
 MODELS = {
     # name -> (constructor kwargs resolver, image size, default batch)
-    "resnet50": (lambda m: m.ResNet50(num_classes=1000), 224, 128),
+    # s2d stem = the bench.py flagship config (docs/benchmarks.md)
+    "resnet50": (lambda m: m.ResNet50(num_classes=1000,
+                                      space_to_depth=True), 224, 128),
     "vgg16": (lambda m: m.VGG16(num_classes=1000), 224, 64),
     "inception3": (lambda m: m.InceptionV3(num_classes=1000), 299, 64),
 }

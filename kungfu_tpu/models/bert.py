@@ -7,6 +7,13 @@ bfloat16 matmuls sized for the MXU (hidden 768 = 6x128, heads 12x64);
 layernorms compute in bf16 with f32 scale/bias (flax reduces LN mean/var
 in f32 internally), so residual-stream activations stay 2 bytes/elem in
 HBM; only the logits head is f32.
+
+Long context: `BertConfig(attention="ring"|"ulysses", seq_axis=...)`
+swaps the attention mixer for a sequence-parallel one from
+`kungfu_tpu.parallel.sequence` — the encoder then expects to run INSIDE
+`shard_map` with the sequence axis sharded over `seq_axis` (token_ids
+are the LOCAL shard; positions are computed globally via the axis
+index). Padding masks are unsupported in the sequence-parallel modes.
 """
 
 from __future__ import annotations
@@ -16,6 +23,7 @@ from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 
 @dataclass(frozen=True)
@@ -27,6 +35,36 @@ class BertConfig:
     intermediate_size: int = 3072
     max_position: int = 512
     dtype: Any = jnp.bfloat16
+    attention: str = "local"  # local | ring | ulysses
+    seq_axis: str = "seq"     # mesh axis for the sequence-parallel modes
+
+    def __post_init__(self):
+        if self.attention not in ("local", "ring", "ulysses"):
+            raise ValueError(
+                f"attention must be local|ring|ulysses, got "
+                f"{self.attention!r}")
+
+
+class SeqParallelAttention(nn.Module):
+    """Multi-head attention whose position mixing runs across the mesh's
+    sequence axis (ring or Ulysses), bidirectional like BERT."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel.sequence import ring_attention, ulysses_attention
+
+        c = self.config
+        h, d = c.num_heads, c.hidden_size // c.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (h, d), dtype=c.dtype, name=name)
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        mixer = (ring_attention if c.attention == "ring"
+                 else ulysses_attention)
+        out = mixer(q, k, v, c.seq_axis, causal=False)
+        return nn.DenseGeneral(c.hidden_size, axis=(-2, -1), dtype=c.dtype,
+                               name="out")(out)
 
 
 class TransformerLayer(nn.Module):
@@ -36,11 +74,18 @@ class TransformerLayer(nn.Module):
     def __call__(self, x, mask=None):
         c = self.config
         y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=c.num_heads,
-            dtype=c.dtype,
-            qkv_features=c.hidden_size,
-        )(y, y, mask=mask)
+        if c.attention == "local":
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=c.num_heads,
+                dtype=c.dtype,
+                qkv_features=c.hidden_size,
+            )(y, y, mask=mask)
+        else:
+            if mask is not None:
+                raise ValueError(
+                    "padding masks are unsupported with sequence-parallel "
+                    f"attention ({c.attention})")
+            y = SeqParallelAttention(c)(y)
         x = x + y
         y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         y = nn.Dense(c.intermediate_size, dtype=c.dtype)(y)
@@ -57,7 +102,20 @@ class BertEncoder(nn.Module):
     @nn.compact
     def __call__(self, token_ids, mask=None):
         c = self.config
-        pos = jnp.arange(token_ids.shape[-1])[None, :]
+        local_len = token_ids.shape[-1]
+        if c.attention == "local":
+            pos = jnp.arange(local_len)[None, :]
+        else:
+            # sequence-sharded: this device holds positions
+            # [rank*local_len, (rank+1)*local_len)
+            global_len = local_len * lax.axis_size(c.seq_axis)
+            if global_len > c.max_position:
+                # nn.Embed would silently clamp the tail positions
+                raise ValueError(
+                    f"global sequence {global_len} exceeds max_position "
+                    f"{c.max_position}; raise BertConfig.max_position")
+            rank = lax.axis_index(c.seq_axis)
+            pos = (rank * local_len + jnp.arange(local_len))[None, :]
         x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype)(token_ids)
         x = x + nn.Embed(c.max_position, c.hidden_size,
                          dtype=c.dtype)(pos)

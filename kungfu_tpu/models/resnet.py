@@ -75,6 +75,12 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # Space-to-depth stem (the MLPerf TPU ResNet trick): rearrange the
+    # input [B,H,W,3] into [B,H/2,W/2,12] and run the stem conv at
+    # stride 1 with a 4x4 kernel. Same receptive-field family as
+    # 7x7/s2, but the input feeds the MXU 12 channels at a time instead
+    # of 3, and the strided gather disappears.
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -97,7 +103,15 @@ class ResNet(nn.Module):
             axis_name=None,
         )
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.space_to_depth:
+            b, h, w, c = x.shape
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                b, h // 2, w // 2, 4 * c)
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     name="conv_init_s2d")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

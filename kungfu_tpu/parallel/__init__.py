@@ -19,6 +19,8 @@ from .mesh import (
     worker_sharding,
 )
 from .pair_host import PairAveragingHost
+from .sequence import (heads_to_seq, ring_attention, seq_to_heads,
+                       ulysses_attention)
 from .train import (build_eval_step, build_train_step,
                     build_train_step_with_state)
 
@@ -35,4 +37,8 @@ __all__ = [
     "build_eval_step",
     "build_train_step_with_state",
     "PairAveragingHost",
+    "ring_attention",
+    "ulysses_attention",
+    "seq_to_heads",
+    "heads_to_seq",
 ]

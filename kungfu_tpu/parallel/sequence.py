@@ -1,0 +1,172 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Beyond reference parity (the reference implements data-parallel variants
+only, SURVEY §2.9): long-context training shards the SEQUENCE across
+devices, and attention — the one op that mixes positions — runs either
+
+- `ring_attention`: K/V shards rotate around the mesh axis via
+  `lax.ppermute` while each device keeps its Q shard; softmax is
+  accumulated online (flash-attention-style running max/denominator), so
+  no device ever materializes full [T, T] scores or the full K/V
+  (Ring Attention, Liu et al. 2023). Communication rides the ICI ring —
+  exactly the topology `ppermute` maps to on TPU.
+- `seq_to_heads` / `heads_to_seq`: DeepSpeed-Ulysses layout switches via
+  `lax.all_to_all` — attention itself then runs fully local with heads
+  sharded, which is cheaper when heads >= devices and the sequence is
+  only moderately long.
+
+All functions are written for use INSIDE `shard_map` over a mesh axis
+(the same way `ops/collective.py` primitives are), with static shapes
+and `lax.fori_loop` control flow so XLA compiles one program per device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _online_block(o, m, l, s, v_blk):
+    """One online-softmax accumulation step.
+
+    o: [B, Tq, H, D] weighted-value accumulator (unnormalized)
+    m: [B, H, Tq]    running row max
+    l: [B, H, Tq]    running denominator
+    s: [B, H, Tq, Tk] this block's scores (already masked)
+    v_blk: [B, Tk, H, D]
+    """
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # rescale previous accumulators to the new max
+    alpha = jnp.exp(m - m_new)  # [B, H, Tq]
+    p = jnp.exp(s - m_new[..., None])  # [B, H, Tq, Tk]
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = (o * alpha.transpose(0, 2, 1)[..., None]
+             + jnp.einsum("bhqk,bkhd->bqhd", p, v_blk))
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Blockwise ring attention over a sequence-sharded mesh axis.
+
+    q/k/v: [B, Ts, H, D] — this device's shard of a sequence of length
+    Ts * axis_size, laid out rank-major (rank r holds positions
+    [r*Ts, (r+1)*Ts)). Returns the attention output for the local Q
+    shard, [B, Ts, H, D]. Peak memory is O(Ts^2) scores per step and one
+    in-flight K/V block — never the full sequence.
+    """
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, ts, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32) * scale
+
+    neg = jnp.finfo(jnp.float32).min
+    perm = [(r, (r + 1) % p) for r in range(p)]
+
+    def accumulate(i, o, m, l, k_blk, v_blk):
+        # this K/V block originated at rank (rank - i) mod p
+        src = (rank - i) % p
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            q_pos = rank * ts + jnp.arange(ts)  # global positions
+            k_pos = src * ts + jnp.arange(ts)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, neg)
+        return _online_block(o, m, l, s, v_blk)
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = accumulate(i, o, m, l, k_blk, v_blk)
+        # rotate K/V one hop around the ring for the next step
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_blk, v_blk
+
+    o0 = jnp.zeros((b, ts, h, d), jnp.float32)
+    m0 = jnp.full((b, h, ts), neg, jnp.float32)
+    l0 = jnp.zeros((b, h, ts), jnp.float32)
+    # the last block is peeled out of the loop so its K/V rotation (whose
+    # result nobody reads) never hits the interconnect
+    o, m, l, k_last, v_last = lax.fori_loop(
+        0, p - 1, body, (o0, m0, l0, k, v))
+    o, m, l = accumulate(p - 1, o, m, l, k_last, v_last)
+    # rows with no visible keys (never happens for causal rank-major
+    # layouts, but keep the division safe)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def seq_to_heads(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """[B, Ts, H, D] sequence-sharded -> [B, Ts*P, H/P, D] head-sharded
+    (DeepSpeed-Ulysses forward all-to-all). Requires H % axis_size == 0."""
+    p = lax.axis_size(axis_name)
+    b, ts, h, d = x.shape
+    x = x.reshape(b, ts, p, h // p, d)
+    # split the head axis across devices, concatenate the sequence axis
+    x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                       tiled=False)
+    return x.reshape(b, ts * p, h // p, d)
+
+
+def heads_to_seq(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Inverse of seq_to_heads: [B, T, H/P, D] -> [B, T/P, H, D]."""
+    p = lax.axis_size(axis_name)
+    b, t, hp, d = x.shape
+    x = x.reshape(b, p, t // p, hp, d)
+    # the source-rank axis must land BEFORE the local-heads axis: source s
+    # held heads [s*hp, (s+1)*hp), so flattening (P, hp) source-major
+    # restores h = s*hp + j — concat_axis=3 would interleave heads
+    # whenever hp > 1
+    x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                       tiled=False)
+    return x.reshape(b, t // p, hp * p, d)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Sequence-parallel attention via head resharding (Ulysses).
+
+    Same contract as `ring_attention` ([B, Ts, H, D] shards in, local
+    output shard out) but the mixing op is two all-to-alls; between
+    them every device holds the FULL sequence for H/P heads and runs
+    plain local attention. Cheaper than the ring when H >= P and
+    Ts*P fits one device's memory for a head subset.
+    """
+    qh = seq_to_heads(q, axis_name)
+    kh = seq_to_heads(k, axis_name)
+    vh = seq_to_heads(v, axis_name)
+    out = _local_attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out, axis_name)
+
+
+def _local_attention(q, k, v, causal=False, scale=None):
+    """Plain full attention on local tensors, [B, T, H, D]."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, jnp.finfo(jnp.float32).min)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,179 @@
+"""Sequence parallelism: ring attention + Ulysses vs full attention.
+
+Beyond reference parity (SURVEY §2.9: the reference is DP-only); the
+rebuild makes long-context first-class. Each test shards a sequence
+across the 8-device CPU mesh, runs the distributed op inside shard_map,
+gathers the shards, and checks against plain full attention on the
+unsharded tensors.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kungfu_tpu.parallel.sequence import (
+    _local_attention,
+    heads_to_seq,
+    ring_attention,
+    seq_to_heads,
+    ulysses_attention,
+)
+
+NDEV = 8
+# H = 16 over 8 devices: H/P = 2, the regime where a wrong all-to-all
+# layout permutes heads (H == P makes that bug invisible)
+B, T, H, D = 2, 64, 16, 16  # T = 8 devices x 8 positions per shard
+
+
+def seq_mesh():
+    return Mesh(np.array(jax.devices()[:NDEV]), ("seq",))
+
+
+def make_qkv(seed=0, dtype=jnp.float32, h=H):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, T, h, D)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+def run_sharded(fn, *args):
+    """Run fn inside shard_map with the sequence axis sharded."""
+    mesh = seq_mesh()
+    mapped = shard_map(
+        fn, mesh=mesh,
+        in_specs=tuple(P(None, "seq") for _ in args),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    )
+    return jax.jit(mapped)(*args)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    q, k, v = make_qkv()
+    out = run_sharded(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal),
+        q, k, v)
+    ref = _local_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    q, k, v = make_qkv(seed=1)
+    out = run_sharded(
+        lambda q, k, v: ulysses_attention(q, k, v, "seq", causal=causal),
+        q, k, v)
+    ref = _local_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_and_ulysses_agree_bf16():
+    q, k, v = make_qkv(seed=2, dtype=jnp.bfloat16)
+    ring = run_sharded(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=True),
+        q, k, v)
+    uly = run_sharded(
+        lambda q, k, v: ulysses_attention(q, k, v, "seq", causal=True),
+        q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(ring, np.float32), np.asarray(uly, np.float32),
+        rtol=2e-2, atol=2e-2)
+    assert ring.dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("h", [8, 16, 32])  # H/P = 1, 2, 4
+def test_seq_heads_round_trip(h):
+    x = jax.random.normal(jax.random.PRNGKey(3), (B, T, h, D))
+
+    def round_trip(x):
+        return heads_to_seq(seq_to_heads(x, "seq"), "seq")
+
+    out = run_sharded(round_trip, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+def test_seq_to_heads_layout():
+    """Head h of the resharded tensor is head h of the input — source-rank
+    blocks must restore head order, not interleave it (H/P = 2 here)."""
+    x = jnp.broadcast_to(
+        jnp.arange(H, dtype=jnp.float32)[None, None, :, None], (B, T, H, D))
+
+    def label_heads(x):
+        y = seq_to_heads(x, "seq")  # [B, T, H/P, D] per device
+        return heads_to_seq(y * 0 + y, "seq")
+
+    # inside-view check: on device r, seq_to_heads must hold heads
+    # [r*hp, (r+1)*hp) — verify via the labels it sees
+    def local_labels(x):
+        y = seq_to_heads(x, "seq")
+        rank = jax.lax.axis_index("seq")
+        hp = y.shape[2]
+        expect = rank * hp + jnp.arange(hp, dtype=jnp.float32)
+        ok = jnp.all(y[0, :, :, 0] == expect[None, :])
+        return jnp.broadcast_to(ok, x.shape[1:2])[None]  # [1, Ts] bool-ish
+
+    mesh = seq_mesh()
+    mapped = shard_map(local_labels, mesh=mesh, in_specs=P(None, "seq"),
+                       out_specs=P(None, "seq"), check_vma=False)
+    ok = jax.jit(mapped)(x)
+    assert bool(np.asarray(ok).all())
+
+
+def test_dp_sp_mesh_composition():
+    """2-D mesh (2 data x 4 seq): ring attention mixes over `seq` while
+    gradients pmean over `data` — one compiled step, both axes live."""
+    from jax import lax
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "seq"))
+    w = jax.random.normal(jax.random.PRNGKey(5), (D, D))
+    q, k, v = make_qkv(seed=6)  # [B=2, T=64, H, D]; B splits over data
+
+    def step(w, q, k, v):
+        out = ring_attention(q @ w, k, v, "seq", causal=True)
+        loss = (out ** 2).mean()
+        g = jax.grad(lambda w: (ring_attention(q @ w, k, v, "seq",
+                                               causal=True) ** 2).mean())(w)
+        # seq shards hold disjoint loss terms (sum), data rows replicas
+        # of the same global batch slice (mean) — the sync_sgd core
+        g = lax.psum(g, "seq")
+        g = lax.pmean(g, "data")
+        loss = lax.psum(loss, "seq")
+        loss = lax.pmean(loss, "data")
+        return loss, g
+
+    mapped = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P("data", "seq"), P("data", "seq"),
+                  P("data", "seq")),
+        out_specs=(P(), P()),
+        check_vma=False)
+    loss, g = jax.jit(mapped)(w, q, k, v)
+    assert np.isfinite(float(loss))
+    assert g.shape == w.shape and np.isfinite(np.asarray(g)).all()
+
+
+def test_ring_attention_grads_flow():
+    """The op differentiates: a jitted loss over the sharded ring matches
+    the full-attention loss gradient."""
+    q, k, v = make_qkv(seed=4)
+    mesh = seq_mesh()
+    mapped = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False)
+
+    def loss_ring(q):
+        return (mapped(q, k, v) ** 2).sum()
+
+    def loss_full(q):
+        return (_local_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring))(q)
+    g_full = jax.grad(loss_full)(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_full),
+                               rtol=1e-4, atol=1e-4)
