@@ -42,6 +42,7 @@ from .topology import (
 )
 
 __all__ = [
+    "flash_attention",
     "all_reduce",
     "all_reduce_mean",
     "group_all_reduce",
@@ -71,3 +72,13 @@ __all__ = [
     "get_neighbour",
     "round_robin",
 ]
+
+
+def __getattr__(name):
+    # lazy: flash pulls in jax.experimental.pallas (+ the Mosaic stack),
+    # which baseline collective/optimizer users should not pay for
+    if name == "flash_attention":
+        from .flash import flash_attention
+
+        return flash_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

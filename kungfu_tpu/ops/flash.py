@@ -1,0 +1,171 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the long-context path (`parallel/sequence.py`): plain
+attention materializes [T, T] scores in HBM; this kernel streams K/V
+blocks through VMEM with online-softmax accumulation so HBM traffic is
+O(T) per query block (FlashAttention, Dao et al. 2022 — on TPU the
+win is HBM bandwidth, the usual bottleneck, not SRAM reuse).
+
+Grid: one program per (batch*head, query-block). Each program keeps its
+Q block, the running max/denominator and the output accumulator in
+VMEM/registers and loops over K/V blocks with `lax.fori_loop`.
+
+`flash_attention` falls back to the plain jnp implementation when
+shapes don't tile (T % block != 0) or on backends without Mosaic
+(interpret mode covers CPU tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale,
+            causal, block_q, block_k):
+    """Grid (B*H, nq, nk), nk innermost: the VMEM scratch (accumulator +
+    running max/denominator) carries the online-softmax state across the
+    sequential K-block steps; K/V blocks stream through VMEM one at a
+    time, so resident VMEM stays O(block) regardless of T."""
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: K blocks entirely above the diagonal contribute nothing
+    diag_ok = (jk * block_k <= (iq + 1) * block_q - 1) if causal else True
+
+    @pl.when(diag_ok)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+        k_blk = k_ref[0].astype(jnp.float32)      # [block_k, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        if causal:
+            q_pos = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = jk * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m = m_ref[:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jk == nk - 1)
+    def _():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _plain_attention(q, k, v, causal, scale):
+    # single reference implementation, shared with the sequence-parallel
+    # mixers (sequence.py has no pallas dependency; this module does)
+    from ..parallel.sequence import _local_attention
+
+    return _local_attention(q, k, v, causal=causal, scale=scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Attention over [B, T, H, D] without materializing [T, T] scores.
+
+    Tiling requires T % block == 0 (and causal additionally
+    block_q % block_k == 0); other shapes use the plain implementation.
+    `interpret=None` auto-selects interpreter mode off-TPU so tests run
+    on the CPU mesh.
+
+    Backward pass: recomputation through the PLAIN attention VJP — the
+    forward saves only q/k/v (flash's O(T) memory win), but the backward
+    currently materializes [T, T] scores per head like standard
+    attention. A fused flash backward kernel is future work.
+    """
+    return _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if (t % block_q or t % block_k
+            or (causal and block_q % block_k)):
+        return _plain_attention(q, k, v, causal, scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # [B, T, H, D] -> [B*H, T, D]: one grid row per (batch, head)
+    def bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    kernel = functools.partial(
+        _kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda i, j, kk: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denominator
+        ],
+        interpret=interpret,
+    )(bh(q), bh(k), bh(v))
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    _, vjp = jax.vjp(lambda q, k, v: _plain_attention(q, k, v, causal,
+                                                      scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

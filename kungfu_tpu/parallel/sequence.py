@@ -84,9 +84,22 @@ def ring_attention(
             s = jnp.where(mask[None, None], s, neg)
         return _online_block(o, m, l, s, v_blk)
 
+    def _maybe_accumulate(i, o, m, l, k_blk, v_blk):
+        if not causal:
+            return accumulate(i, o, m, l, k_blk, v_blk)
+        # a block entirely above the diagonal (src > rank) is fully
+        # masked: skip its einsum/exp, not just its contribution
+        src = (rank - i) % p
+        return lax.cond(
+            src <= rank,
+            lambda o, m, l: accumulate(i, o, m, l, k_blk, v_blk),
+            lambda o, m, l: (o, m, l),
+            o, m, l,
+        )
+
     def body(i, carry):
         o, m, l, k_blk, v_blk = carry
-        o, m, l = accumulate(i, o, m, l, k_blk, v_blk)
+        o, m, l = _maybe_accumulate(i, o, m, l, k_blk, v_blk)
         # rotate K/V one hop around the ring for the next step
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
@@ -99,7 +112,7 @@ def ring_attention(
     # result nobody reads) never hits the interconnect
     o, m, l, k_last, v_last = lax.fori_loop(
         0, p - 1, body, (o0, m0, l0, k, v))
-    o, m, l = accumulate(p - 1, o, m, l, k_last, v_last)
+    o, m, l = _maybe_accumulate(p - 1, o, m, l, k_last, v_last)
     # rows with no visible keys (never happens for causal rank-major
     # layouts, but keep the division safe)
     l = jnp.where(l == 0.0, 1.0, l)
@@ -140,6 +153,7 @@ def ulysses_attention(
     axis_name: str,
     causal: bool = False,
     scale: float | None = None,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Sequence-parallel attention via head resharding (Ulysses).
 
@@ -148,11 +162,21 @@ def ulysses_attention(
     them every device holds the FULL sequence for H/P heads and runs
     plain local attention. Cheaper than the ring when H >= P and
     Ts*P fits one device's memory for a head subset.
+
+    `use_flash` swaps the local step for the Pallas flash kernel
+    (`ops/flash.py`) — needed when the full T x T scores for a head
+    subset would not fit HBM (measured: plain OOMs at T=32k on v5e,
+    flash runs; see docs/benchmarks.md).
     """
     qh = seq_to_heads(q, axis_name)
     kh = seq_to_heads(k, axis_name)
     vh = seq_to_heads(v, axis_name)
-    out = _local_attention(qh, kh, vh, causal=causal, scale=scale)
+    if use_flash:
+        from ..ops.flash import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        out = _local_attention(qh, kh, vh, causal=causal, scale=scale)
     return heads_to_seq(out, axis_name)
 
 

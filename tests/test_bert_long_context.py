@@ -53,7 +53,6 @@ def test_sharded_matches_single_device(attention):
 
 def test_padding_mask_rejected_in_sp_mode():
     cfg = BertConfig(attention="ring", **CFG)
-    tokens = jnp.zeros((B, T // 8), jnp.int32)
     mask = jnp.ones((B, 1, T // 8, T // 8), bool)
     mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
     with pytest.raises(ValueError, match="padding masks"):

@@ -103,10 +103,6 @@ def test_seq_to_heads_layout():
     x = jnp.broadcast_to(
         jnp.arange(H, dtype=jnp.float32)[None, None, :, None], (B, T, H, D))
 
-    def label_heads(x):
-        y = seq_to_heads(x, "seq")  # [B, T, H/P, D] per device
-        return heads_to_seq(y * 0 + y, "seq")
-
     # inside-view check: on device r, seq_to_heads must hold heads
     # [r*hp, (r+1)*hp) — verify via the labels it sees
     def local_labels(x):
@@ -138,12 +134,10 @@ def test_dp_sp_mesh_composition():
         loss = (out ** 2).mean()
         g = jax.grad(lambda w: (ring_attention(q @ w, k, v, "seq",
                                                causal=True) ** 2).mean())(w)
-        # seq shards hold disjoint loss terms (sum), data rows replicas
-        # of the same global batch slice (mean) — the sync_sgd core
-        g = lax.psum(g, "seq")
-        g = lax.pmean(g, "data")
-        loss = lax.psum(loss, "seq")
-        loss = lax.pmean(loss, "data")
+        # per-shard local-mean losses: the global mean's gradient is the
+        # pmean of per-shard partials over BOTH axes (the sync_sgd core)
+        g = lax.pmean(lax.pmean(g, "seq"), "data")
+        loss = lax.pmean(lax.pmean(loss, "seq"), "data")
         return loss, g
 
     mapped = shard_map(
