@@ -27,6 +27,18 @@ from typing import Optional
 from .ffi import OrderGroup
 from .peer import Peer
 
+
+def __getattr__(name):
+    # lazy: checkpoint pulls in jax, which the jax-free control-plane
+    # path (the kfrun launcher) must not pay for at startup
+    if name in ("save_checkpoint", "load_checkpoint", "flatten_tree"):
+        from . import checkpoint
+
+        attr = getattr(checkpoint, name)
+        globals()[name] = attr  # cache: next lookup is a dict hit
+        return attr
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __version__ = "0.1.0"
 
 _default_peer: Optional[Peer] = None

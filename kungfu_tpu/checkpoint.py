@@ -1,0 +1,118 @@
+"""Checkpointing: pytree <-> .npz, dtype-exact.
+
+The reference's elastic hook dumps every variable to
+`variables-<idx>.npz` at end of run (reference: srcs/python/kungfu/
+tensorflow/hooks/elastic.py:70-77). Here any JAX pytree round-trips:
+leaves are flattened under their tree paths, dtypes (bf16 included, via
+a view) and shapes survive exactly, and `load_checkpoint` can either
+rebuild the flat dict or restore into the structure of a template tree.
+
+Live joiner state transfer is separate (elastic/hooks.py resync_params
+streams over DCN); this is durable on-disk state for restart-from-zero
+— the complement the elastic runtime needs when the whole cluster dies.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+_BF16_SUFFIX = "::bf16"  # np.savez cannot store bfloat16 natively
+
+
+def _path_str(path) -> str:
+    out = []
+    for p in path:
+        if hasattr(p, "key"):
+            out.append(str(p.key))
+        elif hasattr(p, "idx"):
+            out.append(str(p.idx))
+        else:
+            out.append(str(p))
+    return "/".join(out)
+
+
+def flatten_tree(tree) -> Dict[str, np.ndarray]:
+    """{tree/path: host array}; bfloat16 leaves stored as a u16 view.
+
+    Raises on key names the flat encoding cannot represent ('/' inside a
+    component, the reserved bf16 suffix, '__step__') — a clear error
+    beats a silently corrupted checkpoint.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in flat:
+        for p in path:
+            name = str(getattr(p, "key", getattr(p, "idx", p)))
+            if "/" in name:
+                raise ValueError(
+                    f"cannot checkpoint key {name!r}: '/' collides with "
+                    "the flat path separator")
+        key = _path_str(path)
+        if key == "__step__" or key.endswith(_BF16_SUFFIX):
+            raise ValueError(f"cannot checkpoint reserved key {key!r}")
+        a = np.asarray(jax.device_get(leaf))
+        if a.dtype == jax.numpy.bfloat16:
+            key += _BF16_SUFFIX
+            a = a.view(np.uint16)
+        if key in out:
+            raise ValueError(f"duplicate flat key {key!r}")
+        out[key] = a
+    return out
+
+
+def save_checkpoint(path: str, tree, step: Optional[int] = None) -> str:
+    """Write a pytree to `path` (.npz appended if missing); returns the
+    final filename. `step` is stored under the reserved key `__step__`."""
+    if not path.endswith(".npz"):
+        path += ".npz"
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = flatten_tree(tree)
+    if step is not None:
+        payload["__step__"] = np.asarray(step, np.int64)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn file
+    return path
+
+
+def load_checkpoint(path: str, like: Any = None):
+    """Read a checkpoint.
+
+    Returns `(tree_or_dict, step)` — `step` is None when absent. With
+    `like`, values are restored into that pytree's structure (paths must
+    match); without it, the flat {path: array} dict is returned.
+    """
+    loaded = np.load(path)
+    flat: Dict[str, np.ndarray] = {}
+    step = None
+    for key in loaded.files:
+        if key == "__step__":
+            step = int(loaded[key])
+            continue
+        a = loaded[key]
+        if key.endswith(_BF16_SUFFIX):
+            key = key[: -len(_BF16_SUFFIX)]
+            a = a.view(jax.numpy.bfloat16)
+        flat[key] = a
+    if like is None:
+        return flat, step
+    paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for path, leaf in paths:
+        key = _path_str(path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        a = flat[key]
+        if tuple(a.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"shape mismatch for {key!r}: checkpoint {a.shape} vs "
+                f"template {np.shape(leaf)}")
+        leaves.append(a)
+    return jax.tree_util.tree_unflatten(treedef, leaves), step

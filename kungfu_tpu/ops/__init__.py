@@ -41,8 +41,7 @@ from .topology import (
     round_robin,
 )
 
-__all__ = [
-    "flash_attention",
+__all__ = [  # flash_attention is exported lazily (see __getattr__)
     "all_reduce",
     "all_reduce_mean",
     "group_all_reduce",
@@ -80,5 +79,6 @@ def __getattr__(name):
     if name == "flash_attention":
         from .flash import flash_attention
 
+        globals()[name] = flash_attention  # cache: next lookup is direct
         return flash_attention
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -166,7 +166,9 @@ def ulysses_attention(
     `use_flash` swaps the local step for the Pallas flash kernel
     (`ops/flash.py`) — needed when the full T x T scores for a head
     subset would not fit HBM (measured: plain OOMs at T=32k on v5e,
-    flash runs; see docs/benchmarks.md).
+    flash runs; see docs/benchmarks.md). FORWARD/INFERENCE ONLY at that
+    scale: the kernel's backward currently recomputes through the plain
+    VJP, which re-materializes the T x T scores.
     """
     qh = seq_to_heads(q, axis_name)
     kh = seq_to_heads(k, axis_name)

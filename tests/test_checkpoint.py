@@ -1,0 +1,75 @@
+"""Checkpoint round-trips: pytree <-> npz, dtype-exact (bf16 included).
+
+Reference analog: hooks/elastic.py:70-77 end-of-run variables-<idx>.npz.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kungfu_tpu import load_checkpoint, save_checkpoint
+
+
+def tree():
+    return {
+        "dense": {"kernel": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                  "bias": jnp.ones(3, jnp.bfloat16) * 1.5},
+        "step_count": jnp.asarray(7, jnp.int32),
+        # host-side f64 leaf: jnp would downcast under default x64-off
+        "nested": [np.zeros(2, np.float64), np.ones(1, np.int64)],
+    }
+
+
+def test_round_trip_into_template(tmp_path):
+    t = tree()
+    path = save_checkpoint(str(tmp_path / "ckpt"), t, step=42)
+    assert path.endswith(".npz")
+    restored, step = load_checkpoint(path, like=t)
+    assert step == 42
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(t)[0],
+            jax.tree_util.tree_flatten_with_path(restored)[0]):
+        assert np.asarray(a).dtype == np.asarray(b).dtype, (ka, kb)
+        np.testing.assert_array_equal(np.asarray(a, np.float64),
+                                      np.asarray(b, np.float64))
+
+
+def test_flat_dict_form(tmp_path):
+    path = save_checkpoint(str(tmp_path / "c.npz"), tree())
+    flat, step = load_checkpoint(path)
+    assert step is None
+    assert flat["dense/kernel"].shape == (2, 3)
+    assert flat["dense/bias"].dtype == jnp.bfloat16
+
+    assert flat["nested/0"].dtype == np.float64
+
+
+def test_template_mismatch_raises(tmp_path):
+    path = save_checkpoint(str(tmp_path / "c"), tree())
+    bad = tree()
+    bad["dense"]["kernel"] = jnp.zeros((3, 3), jnp.float32)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_checkpoint(path, like=bad)
+    bad2 = {"missing": jnp.zeros(1)}
+    with pytest.raises(KeyError, match="missing"):
+        load_checkpoint(path, like=bad2)
+
+
+def test_unrepresentable_keys_rejected(tmp_path):
+    from kungfu_tpu import flatten_tree
+
+    with pytest.raises(ValueError, match="separator"):
+        flatten_tree({"a/b": jnp.zeros(1), "a": {"b": jnp.zeros(1)}})
+    with pytest.raises(ValueError, match="reserved"):
+        flatten_tree({"__step__": jnp.zeros(1)})
+    with pytest.raises(ValueError, match="reserved"):
+        flatten_tree({"x::bf16": jnp.zeros(1, jnp.float32)})
+
+
+def test_atomic_overwrite(tmp_path):
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"a": jnp.zeros(2)})
+    save_checkpoint(p, {"a": jnp.ones(2)})
+    flat, _ = load_checkpoint(p)
+    np.testing.assert_array_equal(flat["a"], np.ones(2, np.float32))
