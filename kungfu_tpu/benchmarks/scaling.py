@@ -1,0 +1,75 @@
+"""Scaling efficiency: per-chip throughput at 1..N chips.
+
+BASELINE.md's north star is >=90% scaling efficiency for ResNet-50
+SyncSGD (the reference's headline plot is relative throughput vs
+Horovod at 8-16 GPUs, README.md:197-205). This harness measures the
+numerator and denominator on whatever backend is visible:
+
+    efficiency(n) = images_per_sec(n) / (n * images_per_sec(1))
+
+On a TPU pod slice it reports real ICI scaling; on the virtual CPU mesh
+it validates the harness itself (CPU "chips" share one socket, so the
+numbers are not hardware claims — the line is labeled accordingly).
+
+Run:  python -m kungfu_tpu.benchmarks.scaling [--model resnet50]
+          [--sizes 1,2,4,8] [--batch 32] [--iters 10]
+
+Prints one JSON line with per-size throughput and efficiencies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from .throughput import MODELS, measure_rate
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=sorted(MODELS), default="resnet50")
+    ap.add_argument("--sizes", default="",
+                    help="comma list; default 1,2,4,... up to all chips")
+    ap.add_argument("--batch", type=int, default=32, help="per-chip batch")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    total = jax.device_count()
+    if args.sizes:
+        sizes = sorted({int(s) for s in args.sizes.split(",")})
+    else:
+        sizes, n = [], 1
+        while n <= total:
+            sizes.append(n)
+            n *= 2
+    feasible = [n for n in sizes if n <= total]
+    if not feasible:
+        raise SystemExit(
+            f"no requested size fits the {total} visible devices: {sizes}")
+    platform = jax.devices()[0].platform
+
+    rates = {n: measure_rate(args.model, n, args.batch, args.iters,
+                             args.warmup)[0]
+             for n in feasible}
+    base = rates[feasible[0]] / feasible[0]
+    out = {
+        "metric": f"{args.model}_syncsgd_scaling_efficiency",
+        "platform": platform,
+        "hardware_claim": platform != "cpu",  # cpu mesh shares one socket
+        "per_chip_batch": args.batch,
+        "images_per_sec": {str(n): round(r, 1) for n, r in rates.items()},
+        "efficiency": {
+            str(n): round(r / (n * base), 3) for n, r in rates.items()
+        },
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -29,14 +29,13 @@ MODELS = {
 }
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=sorted(MODELS), default="resnet50")
-    ap.add_argument("--batch", type=int, default=0, help="per-chip batch")
-    ap.add_argument("--iters", type=int, default=20)
-    ap.add_argument("--warmup", type=int, default=3)
-    args = ap.parse_args(argv)
+def measure_rate(model_name: str, n: int, batch: int = 0, iters: int = 20,
+                 warmup: int = 3):
+    """Images/sec of `n`-device SyncSGD training on `model_name`.
 
+    The one timing harness every image benchmark shares (throughput CLI,
+    scaling-efficiency sweep). Returns (images_per_sec, meta_dict).
+    """
     import jax
     import jax.numpy as jnp
     import optax
@@ -51,25 +50,24 @@ def main(argv=None) -> int:
         shard_batch,
     )
 
-    build, image, default_batch = MODELS[args.model]
-    n = jax.device_count()
+    build, image, default_batch = MODELS[model_name]
     platform = jax.devices()[0].platform
     if platform == "cpu":  # keep the smoke path fast
-        image = 75 if args.model == "inception3" else 64
+        image = 75 if model_name == "inception3" else 64
         default_batch = 4
-        args.iters, args.warmup = min(args.iters, 3), 1
-    args.warmup = max(args.warmup, 1)  # the warmup fence binds `loss`
-    batch = args.batch or default_batch
+        iters = min(iters, 3)
+    warmup = max(warmup, 1)  # the warmup fence binds `loss`
+    batch = batch or default_batch
 
-    mesh = data_mesh(n)
+    mesh = data_mesh(n, devices=jax.devices()[:n])
     model = build(models)
     x = jnp.ones((batch * n, image, image, 3), jnp.float32)
     y = jnp.zeros((batch * n,), jnp.int32)
     k0, k1 = jax.random.split(jax.random.PRNGKey(0))
     # 'dropout' rng for VGG; harmless for BN models. A fixed key per step
     # keeps the step a pure function of its state (throughput-only).
-    rngs = {"params": k0, "dropout": k1}
-    variables = model.init(rngs, x[:2], train=True)
+    variables = model.init({"params": k0, "dropout": k1}, x[:2],
+                           train=True)
     has_bn = "batch_stats" in variables
 
     def loss_fn(params, batch_stats, b):
@@ -91,29 +89,46 @@ def main(argv=None) -> int:
     step = build_train_step_with_state(loss_fn, tx, mesh)
     batch_s = shard_batch({"x": x, "y": y}, mesh)
 
-    for _ in range(args.warmup):
+    for _ in range(warmup):
         params_s, stats_s, opt_s, loss = step(params_s, stats_s, opt_s,
                                               batch_s)
     float(loss)  # true execution fence (see bench.py note)
 
     t0 = time.perf_counter()
-    for _ in range(args.iters):
+    for _ in range(iters):
         params_s, stats_s, opt_s, loss = step(params_s, stats_s, opt_s,
                                               batch_s)
     final_loss = float(loss)
     dt = time.perf_counter() - t0
     assert final_loss == final_loss, "NaN loss in benchmark"
 
-    per_chip = batch * n * args.iters / dt / n
+    rate = batch * n * iters / dt
+    meta = {
+        "platform": platform, "chips": n, "per_chip_batch": batch,
+        "image_size": image, "iters": iters, "dtype": "bfloat16",
+        "step_time_ms": round(1000 * dt / iters, 2),
+    }
+    return rate, meta
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=sorted(MODELS), default="resnet50")
+    ap.add_argument("--batch", type=int, default=0, help="per-chip batch")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    n = jax.device_count()
+    rate, meta = measure_rate(args.model, n, args.batch, args.iters,
+                              args.warmup)
     print(json.dumps({
         "metric": f"{args.model}_syncsgd_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
+        "value": round(rate / n, 2),
         "unit": "images/sec/chip",
-        "details": {
-            "platform": platform, "chips": n, "per_chip_batch": batch,
-            "image_size": image, "iters": args.iters, "dtype": "bfloat16",
-            "step_time_ms": round(1000 * dt / args.iters, 2),
-        },
+        "details": meta,
     }))
     return 0
 

@@ -89,18 +89,18 @@ def load_checkpoint(path: str, like: Any = None):
     `like`, values are restored into that pytree's structure (paths must
     match); without it, the flat {path: array} dict is returned.
     """
-    loaded = np.load(path)
     flat: Dict[str, np.ndarray] = {}
     step = None
-    for key in loaded.files:
-        if key == "__step__":
-            step = int(loaded[key])
-            continue
-        a = loaded[key]
-        if key.endswith(_BF16_SUFFIX):
-            key = key[: -len(_BF16_SUFFIX)]
-            a = a.view(jax.numpy.bfloat16)
-        flat[key] = a
+    with np.load(path) as loaded:
+        for key in loaded.files:
+            if key == "__step__":
+                step = int(loaded[key])
+                continue
+            a = loaded[key]
+            if key.endswith(_BF16_SUFFIX):
+                key = key[: -len(_BF16_SUFFIX)]
+                a = a.view(jax.numpy.bfloat16)
+            flat[key] = a
     if like is None:
         return flat, step
     paths, treedef = jax.tree_util.tree_flatten_with_path(like)
