@@ -40,6 +40,8 @@ def main(argv=None) -> int:
     total = jax.device_count()
     if args.sizes:
         sizes = sorted({int(s) for s in args.sizes.split(",")})
+        if sizes and sizes[0] < 1:
+            ap.error(f"--sizes must be >= 1, got {sizes}")
     else:
         sizes, n = [], 1
         while n <= total:
@@ -54,12 +56,16 @@ def main(argv=None) -> int:
     rates = {n: measure_rate(args.model, n, args.batch, args.iters,
                              args.warmup)[0]
              for n in feasible}
-    base = rates[feasible[0]] / feasible[0]
+    # the documented metric normalizes against 1 chip; when the sweep
+    # starts higher, say so in the output instead of silently rebasing
+    base_n = feasible[0]
+    base = rates[base_n] / base_n
     out = {
         "metric": f"{args.model}_syncsgd_scaling_efficiency",
         "platform": platform,
         "hardware_claim": platform != "cpu",  # cpu mesh shares one socket
         "per_chip_batch": args.batch,
+        "baseline_size": base_n,  # efficiency is vs this size's per-chip rate
         "images_per_sec": {str(n): round(r, 1) for n, r in rates.items()},
         "efficiency": {
             str(n): round(r / (n * base), 3) for n, r in rates.items()

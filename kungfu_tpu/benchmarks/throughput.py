@@ -55,11 +55,15 @@ def measure_rate(model_name: str, n: int, batch: int = 0, iters: int = 20,
     if platform == "cpu":  # keep the smoke path fast
         image = 75 if model_name == "inception3" else 64
         default_batch = 4
-        iters = min(iters, 3)
+        iters, warmup = min(iters, 3), min(warmup, 1)
     warmup = max(warmup, 1)  # the warmup fence binds `loss`
     batch = batch or default_batch
 
-    mesh = data_mesh(n, devices=jax.devices()[:n])
+    # pin a device subset only for sub-size sweeps on one host; a full-
+    # size run must keep data_mesh's default (multi-host pods span
+    # jax.devices() across processes and a slice would strand hosts)
+    devices = None if n == jax.device_count() else jax.devices()[:n]
+    mesh = data_mesh(n, devices=devices)
     model = build(models)
     x = jnp.ones((batch * n, image, image, 3), jnp.float32)
     y = jnp.zeros((batch * n,), jnp.int32)

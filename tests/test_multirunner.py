@@ -53,9 +53,15 @@ def test_two_runner_hostname_cluster(tmp_path):
     b, fb = runner("127.0.0.2", tmp_path / "b", tmp_path / "b.out")
     # self-detects the localhost entry
     a, fa = runner("", tmp_path / "a", tmp_path / "a.out")
-    ra, rb = a.wait(timeout=120), b.wait(timeout=120)
-    fa.close()
-    fb.close()
+    try:
+        ra, rb = a.wait(timeout=120), b.wait(timeout=120)
+    finally:
+        for p in (a, b):  # a hung runner must not leak its worker tree
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        fa.close()
+        fb.close()
     logs = ""
     for d in ("a", "b"):
         for f in sorted(os.listdir(tmp_path / d)):
