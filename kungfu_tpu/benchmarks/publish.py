@@ -319,10 +319,12 @@ def run_adaptation(args):
     return {
         "config": (
             "elastic run: schedule 2->4->1 workers, "
-            f"{args.payload_mb} MiB joiner payload "
-            "(98 MiB = fp32 ResNet-50 state), real kfrun + config "
-            "server + consensus resize + resync (loopback; worker-spawn "
-            "+ JAX import dominates on few-core hosts)"
+            f"{args.payload_mb} MiB joiner payload"
+            + (" (= fp32 ResNet-50 state)" if args.payload_mb == 98
+               else "")
+            + ", real kfrun + config server + consensus resize + resync "
+            "(loopback; worker-spawn + JAX import dominates on few-core "
+            "hosts)"
         ),
         "resizes": int(fields["resizes"]),
         "mean_resize_ms": float(fields["mean"]),
