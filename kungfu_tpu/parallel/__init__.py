@@ -21,6 +21,7 @@ from .mesh import (
 from .pair_host import PairAveragingHost
 from .sequence import (heads_to_seq, ring_attention, seq_to_heads,
                        ulysses_attention)
+from .tensor import bert_tp_rules, shard_params
 from .train import (build_eval_step, build_train_step,
                     build_train_step_with_state)
 
@@ -41,4 +42,6 @@ __all__ = [
     "ulysses_attention",
     "seq_to_heads",
     "heads_to_seq",
+    "bert_tp_rules",
+    "shard_params",
 ]
