@@ -21,6 +21,7 @@ from .mesh import (
 from .pair_host import PairAveragingHost
 from .sequence import (heads_to_seq, ring_attention, seq_to_heads,
                        ulysses_attention)
+from .expert import MoEParams, init_moe_params, moe_mlp
 from .tensor import bert_tp_rules, shard_params
 from .train import (build_eval_step, build_train_step,
                     build_train_step_with_state)
@@ -44,4 +45,7 @@ __all__ = [
     "heads_to_seq",
     "bert_tp_rules",
     "shard_params",
+    "moe_mlp",
+    "init_moe_params",
+    "MoEParams",
 ]
