@@ -22,6 +22,7 @@ from .pair_host import PairAveragingHost
 from .sequence import (heads_to_seq, ring_attention, seq_to_heads,
                        ulysses_attention)
 from .expert import MoEParams, init_moe_params, moe_mlp
+from .pipeline import pipeline_apply, stack_stage_params
 from .tensor import bert_tp_rules, shard_params
 from .train import (build_eval_step, build_train_step,
                     build_train_step_with_state)
@@ -48,4 +49,6 @@ __all__ = [
     "moe_mlp",
     "init_moe_params",
     "MoEParams",
+    "pipeline_apply",
+    "stack_stage_params",
 ]

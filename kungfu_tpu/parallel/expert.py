@@ -29,13 +29,23 @@ class MoEParams(NamedTuple):
 
 
 def init_moe_params(key, hidden: int, ffn: int, num_experts: int,
-                    num_devices: int, dtype=jnp.float32) -> MoEParams:
-    """Per-device shard of the expert weights (localE = E / P)."""
+                    num_devices: int, device_index: int = 0,
+                    dtype=jnp.float32) -> MoEParams:
+    """Per-device shard of the expert weights (localE = E / P).
+
+    `device_index` MUST be this device's position on the expert axis
+    (e.g. `lax.axis_index` inside shard_map, or the host loop index when
+    building shards up front): it is folded into the key so each device
+    gets DISTINCT experts — a replicated key would silently give the
+    model only localE unique experts. The router is keyed without the
+    fold (it must be identical everywhere).
+    """
     if num_experts % num_devices:
         raise ValueError(f"experts {num_experts} must divide over "
                          f"{num_devices} devices")
     local = num_experts // num_devices
-    kr, ku, kd = jax.random.split(key, 3)
+    kr, kl = jax.random.split(key)
+    ku, kd = jax.random.split(jax.random.fold_in(kl, device_index))
     scale = hidden ** -0.5
     return MoEParams(
         router=jax.random.normal(kr, (hidden, num_experts), dtype) * scale,
@@ -43,6 +53,13 @@ def init_moe_params(key, hidden: int, ffn: int, num_experts: int,
         w_down=jax.random.normal(kd, (local, ffn, hidden), dtype)
         * ffn ** -0.5,
     )
+
+
+def moe_capacity(tokens: int, capacity_factor: float,
+                 num_experts: int) -> int:
+    """Per-expert slot count: ceil of mean load x headroom (floor could
+    drop tokens under perfectly balanced routing)."""
+    return max(1, -(-int(tokens * capacity_factor) // num_experts))
 
 
 def _dispatch_tensors(x, router, num_experts: int, capacity: int):
@@ -86,7 +103,7 @@ def moe_mlp(
     t, h = x.shape
     local_e = params.w_up.shape[0]
     num_experts = local_e * p
-    capacity = max(1, int(t * capacity_factor / num_experts))
+    capacity = moe_capacity(t, capacity_factor, num_experts)
 
     dispatch, combine = _dispatch_tensors(x, params.router, num_experts,
                                           capacity)
@@ -98,31 +115,16 @@ def moe_mlp(
     slots = slots.reshape(p, local_e, capacity, h)
     slots = lax.all_to_all(slots, axis_name, split_axis=0, concat_axis=0,
                            tiled=True)
-    # expert FFN on everything this device owns
-    up = jnp.einsum("pech,ehf->pecf", slots,
-                    params.w_up.astype(jnp.float32))
+    # expert FFN on everything this device owns, in the param dtype
+    # (bf16 params keep bf16 MXU throughput; router math stays f32)
+    wdt = params.w_up.dtype
+    up = jnp.einsum("pech,ehf->pecf", slots.astype(wdt), params.w_up)
     act = jax.nn.gelu(up)
-    out = jnp.einsum("pecf,efh->pech", act,
-                     params.w_down.astype(jnp.float32))
+    out = jnp.einsum("pecf,efh->pech", act, params.w_down)
+    out = out.astype(jnp.float32)
     # return slots to their source devices and combine
     out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                          tiled=True)
     out = out.reshape(num_experts, capacity, h)
-    y = jnp.einsum("ect,ech->th", combine, out)
-    return y.astype(x.dtype)
-
-
-def moe_mlp_reference(x, params_full: MoEParams, num_experts: int,
-                      capacity: int):
-    """Unsharded oracle: same routing math, all experts local.
-    `params_full.w_up/w_down` carry ALL experts ([E, H, F] / [E, F, H])."""
-    dispatch, combine = _dispatch_tensors(x, params_full.router,
-                                          num_experts, capacity)
-    slots = jnp.einsum("ect,th->ech", dispatch, x.astype(jnp.float32))
-    up = jnp.einsum("ech,ehf->ecf", slots,
-                    params_full.w_up.astype(jnp.float32))
-    act = jax.nn.gelu(up)
-    out = jnp.einsum("ecf,efh->ech", act,
-                     params_full.w_down.astype(jnp.float32))
     y = jnp.einsum("ect,ech->th", combine, out)
     return y.astype(x.dtype)

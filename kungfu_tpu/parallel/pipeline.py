@@ -1,0 +1,90 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a mesh axis.
+
+The last of the mesh-axis family (dp / sp / tp / ep / pp), beyond the
+reference's DP-only scope: each device owns ONE pipeline stage's
+parameters; microbatches enter at stage 0 and activations hop stage to
+stage with `lax.ppermute` (one ICI neighbor transfer per tick — the
+topology a TPU torus is built for). The schedule is the classic GPipe
+fill-drain: M microbatches complete in M + P - 1 ticks, every tick
+running all P stages in parallel on different microbatches.
+
+Runs INSIDE `shard_map` over the pipe axis like the other mixers. The
+loop is a `lax.fori_loop` with static shapes, so XLA compiles one
+program per device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x: jnp.ndarray,
+    axis_name: str,
+    num_microbatches: int,
+) -> jnp.ndarray:
+    """Apply a P-stage pipeline to the microbatched input x.
+
+    - `stage_fn(params, h) -> h`: one stage's computation; every stage
+      must preserve the activation shape (classic homogeneous pipeline).
+    - `stage_params`: THIS device's stage parameters (stage index =
+      `lax.axis_index(axis_name)`).
+    - `x`: [M, mb, ...] microbatches, identical (replicated) on every
+      device of the axis; M = num_microbatches.
+
+    Returns [M, mb, ...] fully-processed microbatches, REPLICATED across
+    the axis (the last stage's result is psum-broadcast at the end), so
+    callers treat pp like any other axis whose output is replicated.
+    """
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    m = num_microbatches
+    if x.shape[0] != m:
+        raise ValueError(f"x leading dim {x.shape[0]} != microbatches {m}")
+    fwd = [(r, (r + 1) % p) for r in range(p)]
+
+    mb_shape = x.shape[1:]
+    out0 = jnp.zeros((m,) + mb_shape, x.dtype)
+    carry0 = jnp.zeros(mb_shape, x.dtype)
+
+    def tick(i, state):
+        out, carry = state
+        # stage 0 ingests microbatch i (while it exists); later stages
+        # work on whatever arrived from the left neighbor
+        feed = lax.dynamic_index_in_dim(x, jnp.minimum(i, m - 1), 0,
+                                        keepdims=False)
+        h = jnp.where(rank == 0, feed, carry)
+        h = stage_fn(stage_params, h)
+        # the last stage retires microbatch i - (p - 1) when in range
+        done_idx = i - (p - 1)
+        out = jnp.where(
+            (rank == p - 1) & (done_idx >= 0),
+            lax.dynamic_update_index_in_dim(
+                out, h, jnp.clip(done_idx, 0, m - 1), 0),
+            out)
+        # everyone forwards to the right neighbor (ring; stage P-1 ->
+        # stage 0's carry is ignored because rank 0 always takes `feed`)
+        carry = lax.ppermute(h, axis_name, fwd)
+        return out, carry
+
+    out, _ = lax.fori_loop(0, m + p - 1, tick, (out0, carry0))
+    # broadcast the finished microbatches from the last stage so every
+    # device returns the same result (psum with one contributor == a
+    # broadcast; callers then treat pp like any other axis whose output
+    # is replicated)
+    only_last = jnp.where(rank == p - 1, out,
+                          jnp.zeros_like(out))
+    return lax.psum(only_last, axis_name)
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] -> one tree with a leading stage
+    axis, ready to shard with PartitionSpec('pipe', ...)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)

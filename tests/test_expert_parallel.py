@@ -16,10 +16,26 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from kungfu_tpu.parallel.expert import (
     MoEParams,
+    _dispatch_tensors,
     init_moe_params,
+    moe_capacity,
     moe_mlp,
-    moe_mlp_reference,
 )
+
+
+# test-only oracle: same routing math, all experts local (kept here next
+# to its only callers so it can't drift silently inside the package)
+def moe_mlp_reference(x, params_full, num_experts, capacity):
+    dispatch, combine = _dispatch_tensors(x, params_full.router,
+                                          num_experts, capacity)
+    slots = jnp.einsum("ect,th->ech", dispatch, x.astype(jnp.float32))
+    up = jnp.einsum("ech,ehf->ecf", slots,
+                    params_full.w_up.astype(jnp.float32))
+    act = jax.nn.gelu(up)
+    out = jnp.einsum("ecf,efh->ech", act,
+                     params_full.w_down.astype(jnp.float32))
+    y = jnp.einsum("ect,ech->th", combine, out)
+    return y.astype(x.dtype)
 
 P_DEV = 8
 T_LOCAL, H, F = 16, 32, 64
@@ -41,7 +57,7 @@ def test_sharded_matches_local_oracle(num_experts):
     x = jax.random.normal(jax.random.PRNGKey(1),
                           (P_DEV * T_LOCAL, H))
 
-    capacity = max(1, int(T_LOCAL * 1.25 / num_experts))
+    capacity = moe_capacity(T_LOCAL, 1.25, num_experts)
 
     # oracle: per shard, all experts local
     ref_parts = []
@@ -64,6 +80,43 @@ def test_sharded_matches_local_oracle(num_experts):
         out_specs=P("expert"), check_vma=False)
     out = jax.jit(mapped)(x, w_up, w_down)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_gradients_match_local_oracle():
+    """Backward through dispatch + both all_to_alls matches the oracle."""
+    num_experts = 8
+    m = mesh()
+    kr, ku, kd = jax.random.split(jax.random.PRNGKey(3), 3)
+    router = jax.random.normal(kr, (H, num_experts)) * H ** -0.5
+    w_up = jax.random.normal(ku, (num_experts, H, F)) * H ** -0.5
+    w_down = jax.random.normal(kd, (num_experts, F, H)) * F ** -0.5
+    x = jax.random.normal(jax.random.PRNGKey(4), (P_DEV * T_LOCAL, H))
+    capacity = moe_capacity(T_LOCAL, 1.25, num_experts)
+
+    def loss_ref(w_up, w_down):
+        full = MoEParams(router=router, w_up=w_up, w_down=w_down)
+        total = 0.0
+        for d in range(P_DEV):
+            shard = x[d * T_LOCAL:(d + 1) * T_LOCAL]
+            y = moe_mlp_reference(shard, full, num_experts, capacity)
+            total = total + (y ** 2).sum()
+        return total / (P_DEV * T_LOCAL)
+
+    def loss_sharded(w_up, w_down):
+        mapped = shard_map(
+            lambda xs, wu, wd: moe_mlp(
+                xs, MoEParams(router, wu, wd), "expert",
+                capacity_factor=1.25),
+            mesh=m, in_specs=(P("expert"),) * 3, out_specs=P("expert"),
+            check_vma=False)
+        y = mapped(x, w_up, w_down)
+        return (y ** 2).sum() / (P_DEV * T_LOCAL)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(w_up, w_down)
+    g_sh = jax.jit(jax.grad(loss_sharded, argnums=(0, 1)))(w_up, w_down)
+    for a, b in zip(g_ref, g_sh):
+        np.testing.assert_allclose(np.asarray(jax.device_get(b)),
+                                   np.asarray(a), rtol=1e-4, atol=1e-6)
 
 
 def test_capacity_drops_overflow_tokens():
