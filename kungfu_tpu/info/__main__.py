@@ -16,10 +16,13 @@ def main():
 
     print(f"kungfu_tpu {kungfu_tpu.__version__}")
     try:
-        from kungfu_tpu.ffi import load
+        from kungfu_tpu.ffi import load, simd_enabled, trace_enabled
         lib = load()
         ver = lib.kf_version_string().decode()
         print(f"libkf {ver}")
+        print(f"  simd reduce kernels: f32={simd_enabled('float32')} "
+              f"f16={simd_enabled('float16')}")
+        print(f"  tracing (KF_TRACE): {'on' if trace_enabled() else 'off'}")
     except Exception as e:  # library missing is a report, not a crash
         print(f"libkf unavailable: {e}")
     try:

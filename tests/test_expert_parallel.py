@@ -119,6 +119,40 @@ def test_gradients_match_local_oracle():
                                    np.asarray(a), rtol=1e-4, atol=1e-6)
 
 
+def test_bf16_params_bf16_io():
+    """The expert FFN computes in the param dtype; bf16 in, bf16 out,
+    numerically close to the f32 oracle."""
+    num_experts = 8
+    m = mesh()
+    kr, ku, kd = jax.random.split(jax.random.PRNGKey(5), 3)
+    router = jax.random.normal(kr, (H, num_experts)) * H ** -0.5
+    w_up = (jax.random.normal(ku, (num_experts, H, F)) * H ** -0.5)
+    w_down = (jax.random.normal(kd, (num_experts, F, H)) * F ** -0.5)
+    x = jax.random.normal(jax.random.PRNGKey(6), (P_DEV * T_LOCAL, H))
+    capacity = moe_capacity(T_LOCAL, 1.25, num_experts)
+
+    mapped = shard_map(
+        lambda xs, wu, wd: moe_mlp(
+            xs, MoEParams(router, wu, wd), "expert"),
+        mesh=m, in_specs=(P("expert"),) * 3, out_specs=P("expert"),
+        check_vma=False)
+    out = jax.jit(mapped)(x.astype(jnp.bfloat16),
+                          w_up.astype(jnp.bfloat16),
+                          w_down.astype(jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+    full = MoEParams(router=router, w_up=w_up, w_down=w_down)
+    # the oracle must route on the SAME quantized inputs: a top-2 logit
+    # gap below bf16 quantization error would otherwise flip an argmax
+    # and produce an O(1) per-token mismatch
+    xq = x.astype(jnp.bfloat16).astype(jnp.float32)
+    ref = np.concatenate([
+        np.asarray(moe_mlp_reference(xq[d * T_LOCAL:(d + 1) * T_LOCAL],
+                                     full, num_experts, capacity))
+        for d in range(P_DEV)])
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=1e-1, atol=5e-2)
+
+
 def test_capacity_drops_overflow_tokens():
     """With capacity 1 and tokens all preferring one expert, only the
     first token per shard gets processed; the rest pass through as 0."""
