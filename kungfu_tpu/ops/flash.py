@@ -26,10 +26,45 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
+_LANES = 128  # TPU lane width: per-row scalars ride a broadcast lane dim
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale,
-            causal, block_q, block_k):
+def _rowvals(ref_blk, width):
+    """[block_q, _LANES] all-equal-lane block -> [block_q, width] tile
+    usable directly against a score block (width = block_k). Lanes are
+    identical, so tiling up to a multiple of _LANES and slicing back
+    covers every width."""
+    if width <= _LANES:
+        return ref_blk[:, :width]
+    reps = -(-width // _LANES)
+    tiled = jnp.tile(ref_blk, (1, reps))
+    return tiled if tiled.shape[1] == width else tiled[:, :width]
+
+
+def _scores(q_blk, k_blk, iq, jk, *, scale, causal, block_q, block_k):
+    """Scaled (and causal-masked) score block [block_q, block_k] —
+    shared by the forward and both backward kernels so the masking and
+    scaling semantics cannot drift apart."""
+    s = jax.lax.dot_general(
+        q_blk.astype(jnp.float32) * scale, k_blk.astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = iq * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = jk * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
+def _diag_ok(iq, jk, causal, block_q, block_k):
+    """False only for causal K blocks entirely above the diagonal."""
+    return (jk * block_k <= (iq + 1) * block_q - 1) if causal else True
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+            scale, causal, block_q, block_k):
     """Grid (B*H, nq, nk), nk innermost: the VMEM scratch (accumulator +
     running max/denominator) carries the online-softmax state across the
     sequential K-block steps; K/V blocks stream through VMEM one at a
@@ -44,23 +79,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: K blocks entirely above the diagonal contribute nothing
-    diag_ok = (jk * block_k <= (iq + 1) * block_q - 1) if causal else True
-
-    @pl.when(diag_ok)
+    @pl.when(_diag_ok(iq, jk, causal, block_q, block_k))
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
-        k_blk = k_ref[0].astype(jnp.float32)      # [block_k, D]
+        s = _scores(q_ref[0], k_ref[0], iq, jk, scale=scale,
+                    causal=causal, block_q=block_q, block_k=block_k)
         v_blk = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [block_q, block_k]
-        if causal:
-            q_pos = iq * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = jk * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m = m_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
@@ -76,6 +99,22 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale,
         l = l_ref[:, 0]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # per-row logsumexp of the scaled scores — the backward
+            # kernels reconstruct p = exp(s - lse) from it instead of
+            # saving [T, T]. Mosaic block tiling needs a 128-wide lane
+            # dim, so the row value is broadcast across _LANES lanes
+            # (the jax.experimental flash kernel's layout); the caller
+            # keeps one lane as the residual. Skipped entirely on the
+            # no-grad forward (save_lse=False).
+            lse_ref[0] = jnp.broadcast_to(
+                (m_ref[:, 0] + jnp.log(l))[:, None], (block_q, _LANES))
+
+
+def _kernel_nolse(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale, causal, block_q, block_k):
+    _kernel(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref, l_ref,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
 
 
 def _plain_attention(q, k, v, causal, scale):
@@ -104,35 +143,62 @@ def flash_attention(
     `interpret=None` auto-selects interpreter mode off-TPU so tests run
     on the CPU mesh.
 
-    Backward pass: recomputation through the PLAIN attention VJP — the
-    forward saves only q/k/v (flash's O(T) memory win), but the backward
-    currently materializes [T, T] scores per head like standard
-    attention. A fused flash backward kernel is future work.
+    Backward pass: fused flash backward kernels — the forward saves only
+    (q, k, v, o, lse), and dq/dk/dv are computed blockwise with the
+    FlashAttention-2 recurrence (p re-materialized per block from the
+    saved logsumexp), so both directions are O(T) in HBM. Non-tiling
+    shapes fall back to the plain VJP.
     """
-    return _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
-                           interpret)
+    out, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                             interpret, save_lse=False)
+    return out
 
 
-def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
-    b, t, h, d = q.shape
-    if scale is None:
-        scale = 1.0 / (d ** 0.5)
+def _tiles(t, causal, block_q, block_k):
+    """The (block_q, block_k) actually usable for length t, or None."""
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     if (t % block_q or t % block_k
             or (causal and block_q % block_k)):
-        return _plain_attention(q, k, v, causal, scale)
+        return None
+    return block_q, block_k
+
+
+def _bh(x):
+    """[B, T, H, D] -> [B*H, T, D]: one grid row per (batch, head)."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unbh(x, b, h):
+    bh_, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
+                    save_lse):
+    """Returns (out, lse) — lse is None on the plain-attention fallback
+    or when `save_lse` is False (the no-grad forward skips the extra
+    [B*H, T, _LANES] output entirely: no HBM allocation, no writes)."""
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    tiles = _tiles(t, causal, block_q, block_k)
+    if tiles is None:
+        return _plain_attention(q, k, v, causal, scale), None
+    block_q, block_k = tiles
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    # [B, T, H, D] -> [B*H, T, D]: one grid row per (batch, head)
-    def bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
     kernel = functools.partial(
-        _kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k)
-    out = pl.pallas_call(
+        _kernel if save_lse else _kernel_nolse, scale=scale,
+        causal=causal, block_q=block_q, block_k=block_k)
+    o_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0))
+    o_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
+    lse_spec = pl.BlockSpec((1, block_q, _LANES),
+                            lambda i, j, kk: (i, j, 0))
+    lse_shape = jax.ShapeDtypeStruct((b * h, t, _LANES), jnp.float32)
+    result = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, t // block_k),
         in_specs=[
@@ -140,32 +206,181 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda i, j, kk: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=[o_spec, lse_spec] if save_lse else o_spec,
+        out_shape=[o_shape, lse_shape] if save_lse else o_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
             pltpu.VMEM((block_q, 1), jnp.float32),   # running denominator
         ],
         interpret=interpret,
-    )(bh(q), bh(k), bh(v))
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    )(_bh(q), _bh(k), _bh(v))
+    if not save_lse:
+        return _unbh(result, b, h), None
+    out, lse = result
+    return _unbh(out, b, h), lse[:, :, 0]  # keep one lane as residual
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, scale, causal, block_q, block_k):
+    """Grid (B*H, nq, nk), nk innermost: accumulate dq for one Q block
+    while K/V/blocks stream by. p is rebuilt from the saved lse, never
+    stored: ds = p * (dp - delta); dq += scale * ds @ k."""
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(_diag_ok(iq, jk, causal, block_q, block_k))
+    def _():
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _scores(q_ref[0], k_ref[0], iq, jk, scale=scale,
+                    causal=causal, block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - _rowvals(lse_ref[0], block_k))
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - _rowvals(delta_ref[0], block_k))
+        acc_ref[:] += scale * jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jk == nk - 1)
+    def _():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k):
+    """Grid (B*H, nk, nq), nq innermost: accumulate dk/dv for one K/V
+    block while Q/dO blocks stream by. dv += p^T @ do;
+    dk += scale * ds^T @ q."""
+    jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_diag_ok(iq, jk, causal, block_q, block_k))
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _scores(q_ref[0], k_ref[0], iq, jk, scale=scale,
+                    causal=causal, block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - _rowvals(lse_ref[0], block_k))  # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # p^T @ do
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - _rowvals(delta_ref[0], block_k))
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # ds^T @ q
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    b, t, h, d = q.shape
+    block_q, block_k = _tiles(t, causal, block_q, block_k)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    qb, kb, vb = _bh(q), _bh(k), _bh(v)
+    dob = _bh(g)
+    # delta_i = rowsum(dO * O): one cheap elementwise pass, shared by
+    # both kernels (FlashAttention-2 eq. 4); lane-broadcast alongside
+    # lse so the kernels get Mosaic-tileable [block_q, _LANES] blocks
+    delta = jnp.sum(dob.astype(jnp.float32)
+                    * _bh(o).astype(jnp.float32), axis=-1)  # [BH, T]
+    lse3 = jnp.broadcast_to(lse[:, :, None], (b * h, t, _LANES))
+    delta3 = jnp.broadcast_to(delta[:, :, None], (b * h, t, _LANES))
+
+    nq, nk = t // block_q, t // block_k
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda i, j, kk: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda i, j, kk: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse3, delta3)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kb, vb, qb, dob, lse3, delta3)
+    return (_unbh(dq, b, h), _unbh(dk, b, h), _unbh(dv, b, h))
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                               interpret, save_lse=True)
+    # lse is None on the fallback path -> plain VJP in _flash_bwd
+    return out, (q, k, v, out if lse is not None else None, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    _, vjp = jax.vjp(lambda q, k, v: _plain_attention(q, k, v, causal,
-                                                      scale), q, k, v)
-    return vjp(g)
+    if lse is None:  # shapes didn't tile: mirror the fallback forward
+        _, vjp = jax.vjp(lambda q, k, v: _plain_attention(q, k, v, causal,
+                                                          scale), q, k, v)
+        return vjp(g)
+    return _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q,
+                           block_k, interpret)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

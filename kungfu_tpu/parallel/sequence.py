@@ -166,9 +166,9 @@ def ulysses_attention(
     `use_flash` swaps the local step for the Pallas flash kernel
     (`ops/flash.py`) — needed when the full T x T scores for a head
     subset would not fit HBM (measured: plain OOMs at T=32k on v5e,
-    flash runs; see docs/benchmarks.md). FORWARD/INFERENCE ONLY at that
-    scale: the kernel's backward currently recomputes through the plain
-    VJP, which re-materializes the T x T scores.
+    flash runs fwd+bwd; see docs/benchmarks.md). Both directions are
+    O(T) in HBM: the kernel's backward is the fused FlashAttention-2
+    recurrence over the saved logsumexp, never the T x T scores.
     """
     qh = seq_to_heads(q, axis_name)
     kh = seq_to_heads(k, axis_name)

@@ -97,3 +97,99 @@ def test_jit_and_grad():
     g_ref = jax.grad(loss_plain)(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                rtol=1e-4, atol=1e-4)
+
+
+class TestFlashBackwardKernels:
+    """The fused backward kernels (dq / dk+dv) vs the plain-attention VJP.
+
+    Comparisons run under `highest` matmul precision: this platform's
+    default f32 matmul is bf16-grade (~1e-1 abs error on unit normals),
+    which would swamp the kernel-vs-plain delta being measured.
+    """
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("t,block_q,block_k",
+                             [(256, 128, 128), (512, 128, 64),
+                              (128, 64, 64)])
+    def test_grads_match_plain(self, causal, t, block_q, block_k):
+        with jax.default_matmul_precision("highest"):
+            q, k, v = qkv(t=t, d=64)
+            g = jax.random.normal(jax.random.PRNGKey(9), q.shape,
+                                  q.dtype)
+
+            def loss_flash(q, k, v):
+                return jnp.vdot(
+                    flash_attention(q, k, v, causal, None, block_q,
+                                    block_k), g)
+
+            def loss_plain(q, k, v):
+                return jnp.vdot(
+                    _plain_attention(q, k, v, causal,
+                                     1.0 / (64 ** 0.5)), g)
+
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            gp = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+            for name, a, b in zip("dq dk dv".split(), gf, gp):
+                scale = float(jnp.max(jnp.abs(b)))
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b),
+                    rtol=0, atol=2e-4 * scale, err_msg=name)
+
+    def test_bf16_grads(self):
+        q, k, v = qkv(t=128, dtype=jnp.bfloat16)
+        g = jax.random.normal(jax.random.PRNGKey(9), q.shape, q.dtype)
+
+        def loss(q, k, v):
+            return jnp.vdot(
+                flash_attention(q, k, v, True, None, 64, 64)
+                .astype(jnp.float32), g.astype(jnp.float32))
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for name, a in zip("dq dk dv".split(), grads):
+            assert a.dtype == jnp.bfloat16, name
+            assert bool(jnp.all(jnp.isfinite(a.astype(jnp.float32)))), name
+            assert float(jnp.max(jnp.abs(a.astype(jnp.float32)))) > 0, name
+
+    def test_untileable_shape_grads_fall_back(self):
+        """t=100 doesn't tile: forward AND backward take the plain path
+        (the residual carries lse=None), still correct."""
+        with jax.default_matmul_precision("highest"):
+            q, k, v = qkv(t=100)
+            g = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+            def loss_flash(q, k, v):
+                return jnp.vdot(flash_attention(q, k, v, True), g)
+
+            def loss_plain(q, k, v):
+                return jnp.vdot(
+                    _plain_attention(q, k, v, True,
+                                     1.0 / (32 ** 0.5)), g)
+
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            gp = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(gf, gp):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-5)
+
+    def test_above_lane_width_blocks(self):
+        """Regression: block sizes > 128 that are not multiples of 128
+        crashed the backward's lane-broadcast tiling (_rowvals)."""
+        with jax.default_matmul_precision("highest"):
+            q, k, v = qkv(t=384, d=64)
+            g = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+            def loss(q, k, v):
+                return jnp.vdot(
+                    flash_attention(q, k, v, False, None, 192, 192), g)
+
+            def loss_plain(q, k, v):
+                return jnp.vdot(
+                    _plain_attention(q, k, v, False,
+                                     1.0 / (64 ** 0.5)), g)
+
+            gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            gp = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(gf, gp):
+                scale = float(jnp.max(jnp.abs(b)))
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=0, atol=2e-4 * scale)
