@@ -1,0 +1,70 @@
+"""GPT with composed data x tensor parallelism (the Megatron recipe).
+
+Beyond the reference's DP-only scope: a decoder-only LM whose weights
+are sharded Megatron-style over the "model" mesh axis while the batch
+shards over "data" — one `jax.jit` training step, XLA/GSPMD inserts the
+ICI collectives. Run on the virtual CPU mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/gpt_dp_tp.py
+
+or on a real TPU slice (mesh shape adapts to the device count).
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kungfu_tpu.models import GPTConfig, GPTLM, gpt_loss
+from kungfu_tpu.parallel import gpt_tp_rules, shard_params
+
+
+def main():
+    n = jax.device_count()
+    d_model = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    d_data = n // d_model
+    mesh = Mesh(np.array(jax.devices()).reshape(d_data, d_model),
+                ("data", "model"))
+    print(f"mesh: {d_data} data x {d_model} model "
+          f"({jax.devices()[0].platform})")
+
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=4,
+                    num_heads=8, intermediate_size=256, max_position=128,
+                    dtype=jnp.float32)
+    model = GPTLM(cfg)
+
+    rng = np.random.default_rng(0)
+    corpus = rng.integers(0, cfg.vocab_size, (8 * d_data, 64))
+    tokens = jnp.asarray(corpus)
+
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+    params = shard_params(jax.device_get(params), mesh, gpt_tp_rules())
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("data")))
+
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt_loss(model.apply({"params": p}, tokens),
+                               tokens))(params)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    for i in range(30):
+        params, opt, loss = step(params, opt, tokens)
+        if i % 5 == 0 or i == 29:
+            print(f"step {i:3d}  loss {float(loss):.4f}")
+    uniform = float(np.log(cfg.vocab_size))
+    print(f"uniform baseline {uniform:.4f}; memorization "
+          f"{'succeeded' if float(loss) < uniform / 3 else 'in progress'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@ stay in exact parity with the architectures.
 
 from .bert import BertConfig, BertEncoder
 from .fake_models import fake_model_catalog, model_param_sizes
+from .gpt import GPTConfig, GPTLM, gpt_loss
 from .inception import InceptionV3
 from .mlp import MLP, SLP
 from .resnet import ResNet, ResNet18, ResNet50, ResNet101
@@ -28,6 +29,9 @@ __all__ = [
     "InceptionV3",
     "BertConfig",
     "BertEncoder",
+    "GPTConfig",
+    "GPTLM",
+    "gpt_loss",
     "fake_model_catalog",
     "model_param_sizes",
 ]

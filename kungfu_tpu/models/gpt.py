@@ -1,0 +1,160 @@
+"""GPT — decoder-only transformer LM, the composed-parallelism flagship.
+
+Beyond the reference's scope (SURVEY §2.9: the reference trains
+data-parallel only and ships no language models), this model is built
+to exercise every parallel axis the framework provides, composed:
+
+- **dp x tp** (the Megatron recipe): shard the parameters with
+  `parallel.tensor.shard_params(params, mesh, gpt_tp_rules())` over a
+  ("data", "model") mesh and jit the train step — GSPMD inserts the
+  all-gathers/reduce-scatters on ICI. Attention projections and the MLP
+  use fixed module names (query/key/value/out, Dense_0/Dense_1 inside
+  `Block`) so the sharding rules match by path.
+- **sequence parallelism**: `GPTConfig(attention="ring"|"ulysses")`
+  swaps the mixer for the causal sequence-parallel ones in
+  `parallel.sequence`; the model then runs INSIDE `shard_map` with
+  token shards, like `models/bert.py`.
+- **flash**: `GPTConfig(attention="flash")` runs the Pallas kernel
+  (`ops/flash.py`) for the local causal mixer — O(T) HBM both
+  directions, for contexts whose [T, T] scores don't fit.
+
+Norm/dtype conventions follow `models/bert.py`: bf16 matmuls and
+residual stream, f32 LayerNorm scale/bias, f32 logits head.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+_ATTN_MODES = ("local", "flash", "ring", "ulysses")
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 1024
+    dtype: Any = jnp.bfloat16
+    attention: str = "local"  # local | flash | ring | ulysses
+    seq_axis: str = "seq"     # mesh axis for the sequence-parallel modes
+
+    def __post_init__(self):
+        if self.attention not in _ATTN_MODES:
+            raise ValueError(
+                f"attention must be one of {_ATTN_MODES}, got "
+                f"{self.attention!r}")
+        if self.hidden_size % self.num_heads:
+            raise ValueError(
+                f"hidden {self.hidden_size} % heads {self.num_heads} != 0")
+
+
+class CausalSelfAttention(nn.Module):
+    """Multi-head causal self-attention with a pluggable mixer.
+
+    Projection modules are named (query/key/value/out) so
+    `parallel.tensor.gpt_tp_rules` can target them by path.
+    """
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        h, d = c.num_heads, c.hidden_size // c.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (h, d), dtype=c.dtype, name=name)
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        if c.attention == "local":
+            t = x.shape[-2]
+            mask = nn.make_causal_mask(jnp.zeros((1, t)))
+            out = nn.dot_product_attention(q, k, v, mask=mask,
+                                           dtype=c.dtype)
+        elif c.attention == "flash":
+            from ..ops.flash import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            from ..parallel.sequence import (
+                ring_attention,
+                ulysses_attention,
+            )
+
+            mixer = (ring_attention if c.attention == "ring"
+                     else ulysses_attention)
+            out = mixer(q, k, v, c.seq_axis, causal=True)
+        return nn.DenseGeneral(c.hidden_size, axis=(-2, -1),
+                               dtype=c.dtype, name="out")(out)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block (GPT-2 style)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
+        x = x + CausalSelfAttention(c)(y)
+        y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
+        y = nn.Dense(c.intermediate_size, dtype=c.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(c.hidden_size, dtype=c.dtype)(y)
+        return x + y
+
+
+class GPTLM(nn.Module):
+    """Token ids [B, T] -> next-token logits [B, T, vocab] (f32)."""
+
+    config: GPTConfig = GPTConfig()  # frozen dataclass: hashable default
+
+    @nn.compact
+    def __call__(self, token_ids):
+        c = self.config
+        local_len = token_ids.shape[-1]
+        if c.attention in ("ring", "ulysses"):
+            # sequence-sharded: this device holds positions
+            # [rank*local_len, (rank+1)*local_len)
+            global_len = local_len * lax.axis_size(c.seq_axis)
+            if global_len > c.max_position:
+                raise ValueError(
+                    f"global sequence {global_len} exceeds max_position "
+                    f"{c.max_position}; raise GPTConfig.max_position")
+            rank = lax.axis_index(c.seq_axis)
+            pos = (rank * local_len + jnp.arange(local_len))[None, :]
+        else:
+            if local_len > c.max_position:
+                raise ValueError(
+                    f"sequence {local_len} exceeds max_position "
+                    f"{c.max_position}; raise GPTConfig.max_position")
+            pos = jnp.arange(local_len)[None, :]
+        x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                     name="wte")(token_ids)
+        x = x + nn.Embed(c.max_position, c.hidden_size, dtype=c.dtype,
+                         name="wpe")(pos)
+        for _ in range(c.num_layers):
+            x = Block(c)(x)
+        x = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
+        return nn.Dense(c.vocab_size, dtype=jnp.float32,
+                        name="lm_head")(x)
+
+
+def gpt_loss(logits, token_ids):
+    """Mean next-token cross entropy: logits[t] predicts token[t+1].
+
+    The last position has no target and is dropped; caller-side masking
+    is unnecessary for the synthetic/benchmark corpora this framework
+    trains on.
+    """
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1].astype(jnp.float32), token_ids[:, 1:]).mean()

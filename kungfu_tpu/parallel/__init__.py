@@ -23,7 +23,7 @@ from .sequence import (heads_to_seq, ring_attention, seq_to_heads,
                        ulysses_attention)
 from .expert import MoEParams, init_moe_params, moe_mlp
 from .pipeline import pipeline_apply, stack_stage_params
-from .tensor import bert_tp_rules, shard_params
+from .tensor import bert_tp_rules, gpt_tp_rules, shard_params
 from .train import (build_eval_step, build_train_step,
                     build_train_step_with_state)
 
@@ -45,6 +45,7 @@ __all__ = [
     "seq_to_heads",
     "heads_to_seq",
     "bert_tp_rules",
+    "gpt_tp_rules",
     "shard_params",
     "moe_mlp",
     "init_moe_params",

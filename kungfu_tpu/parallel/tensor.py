@@ -34,27 +34,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 Rules = Sequence[Tuple[str, P]]
 
 
-def bert_tp_rules(axis: str = "model") -> Rules:
-    """Megatron split for models/bert.py parameter paths.
+def _megatron_rules(scope: str, axis: str) -> Rules:
+    """The Megatron split, anchored to a transformer-block scope name.
 
-    Rules are anchored to the TransformerLayer scope: the encoder's
-    top-level vocab logits head is also auto-named `Dense_0`, and vocab
-    sizes (30522) rarely divide a model axis — the head stays
-    replicated.
+    Anchoring matters: the models' top-level vocab logits heads are also
+    auto-named `Dense_0`, and vocab sizes (30522/50257) rarely divide a
+    model axis — heads and embeddings stay replicated by not matching.
     """
     return (
         # attention (flax MultiHeadDotProductAttention / the seq-parallel
-        # module): QKV projections column-parallel (heads shard), output
+        # modules): QKV projections column-parallel (heads shard), output
         # projection row-parallel
         (r".*(query|key|value).*kernel", P(None, axis, None)),
-        (r".*out.*kernel", P(axis, None, None)),
+        (rf".*{scope}.*out.*kernel", P(axis, None, None)),
         # MLP: up-projection column-parallel, down-projection row-parallel
-        (r".*TransformerLayer.*Dense_0.*kernel", P(None, axis)),
-        (r".*TransformerLayer.*Dense_1.*kernel", P(axis, None)),
+        (rf".*{scope}.*Dense_0.*kernel", P(None, axis)),
+        (rf".*{scope}.*Dense_1.*kernel", P(axis, None)),
         # biases of column-parallel layers shard with the features
         (r".*(query|key|value).*bias", P(axis, None)),
-        (r".*TransformerLayer.*Dense_0.*bias", P(axis,)),
+        (rf".*{scope}.*Dense_0.*bias", P(axis,)),
     )
+
+
+def bert_tp_rules(axis: str = "model") -> Rules:
+    """Megatron split for models/bert.py parameter paths."""
+    return _megatron_rules("TransformerLayer", axis)
+
+
+def gpt_tp_rules(axis: str = "model") -> Rules:
+    """Megatron split for models/gpt.py parameter paths (Block scope)."""
+    return _megatron_rules("Block", axis)
 
 
 def _path_str(path) -> str:
