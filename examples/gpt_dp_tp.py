@@ -11,8 +11,6 @@ ICI collectives. Run on the virtual CPU mesh:
 or on a real TPU slice (mesh shape adapts to the device count).
 """
 
-import functools
-
 import numpy as np
 
 import jax
@@ -21,7 +19,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kungfu_tpu.models import GPTConfig, GPTLM, gpt_loss
-from kungfu_tpu.parallel import gpt_tp_rules, shard_params
+from kungfu_tpu.parallel import (build_gspmd_train_step, gpt_tp_rules,
+                                 shard_params)
 
 
 def main():
@@ -48,14 +47,8 @@ def main():
 
     tx = optax.adam(1e-2)
     opt = tx.init(params)
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: gpt_loss(model.apply({"params": p}, tokens),
-                               tokens))(params)
-        updates, opt = tx.update(grads, opt, params)
-        return optax.apply_updates(params, updates), opt, loss
+    step = build_gspmd_train_step(
+        lambda p, t: gpt_loss(model.apply({"params": p}, t), t), tx)
 
     for i in range(30):
         params, opt, loss = step(params, opt, tokens)

@@ -37,7 +37,8 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from kungfu_tpu.models import GPTConfig, GPTLM, gpt_loss
-    from kungfu_tpu.parallel import gpt_tp_rules, shard_params
+    from kungfu_tpu.parallel import (build_gspmd_train_step,
+                                     gpt_tp_rules, shard_params)
 
     n = jax.device_count()
     platform = jax.devices()[0].platform
@@ -64,17 +65,8 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
 
     tx = optax.adamw(1e-4)
     opt = tx.init(params)
-    import functools
-
-    # donate params+opt: without it XLA double-buffers ~4.2 GB of
-    # f32 params + adamw state at the 'medium' size
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: gpt_loss(model.apply({"params": p}, tokens),
-                               tokens))(params)
-        updates, opt = tx.update(grads, opt, params)
-        return optax.apply_updates(params, updates), opt, loss
+    step = build_gspmd_train_step(
+        lambda p, t: gpt_loss(model.apply({"params": p}, t), t), tx)
 
     for _ in range(max(warmup, 1)):
         params, opt, loss = step(params, opt, tokens)

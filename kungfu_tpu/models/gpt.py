@@ -45,6 +45,12 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     attention: str = "local"  # local | flash | ring | ulysses
     seq_axis: str = "seq"     # mesh axis for the sequence-parallel modes
+    # Mixture-of-experts FFN (Switch top-1): 0 = dense MLP. Expert
+    # stacks are GLOBAL arrays [E, H, F]; shard them over a mesh axis
+    # with `parallel.tensor.gpt_moe_rules` and GSPMD lowers the
+    # dispatch/combine einsums to all-to-alls — no shard_map needed.
+    num_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self):
         if self.attention not in _ATTN_MODES:
@@ -94,8 +100,53 @@ class CausalSelfAttention(nn.Module):
                                dtype=c.dtype, name="out")(out)
 
 
+class MoEMLP(nn.Module):
+    """Switch top-1 MoE feed-forward in the einsum dispatch formulation.
+
+    Unlike `parallel.expert.moe_mlp` (shard_map, per-device shards),
+    this module's expert stacks are GLOBAL parameters [E, H, F] — the
+    idiomatic GSPMD form: annotate w_up/w_down with
+    PartitionSpec("expert"|"model", ...) (`gpt_moe_rules`) and the
+    compiler turns the dispatch/combine einsums into all-to-alls over
+    ICI. Routing math is f32; expert matmuls run in the param dtype.
+    """
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel.expert import dispatch_tensors, moe_capacity
+
+        c = self.config
+        b, t, h = x.shape
+        e, f = c.num_experts, c.intermediate_size
+        router = self.param(
+            "router", nn.initializers.normal(h ** -0.5), (h, e),
+            jnp.float32)
+        w_up = self.param(
+            "w_up", nn.initializers.normal(h ** -0.5), (e, h, f),
+            jnp.float32).astype(c.dtype)
+        w_down = self.param(
+            "w_down", nn.initializers.normal(f ** -0.5), (e, f, h),
+            jnp.float32).astype(c.dtype)
+        tokens = x.reshape(b * t, h)
+        capacity = moe_capacity(b * t, c.moe_capacity_factor, e)
+        dispatch, combine = dispatch_tensors(
+            tokens, router, e, capacity)              # [E, C, BT] f32
+        # gather in the param dtype (dispatch entries are exact 0/1);
+        # gate-weighted combine stays f32 like parallel.expert.moe_mlp
+        slots = jnp.einsum("ect,th->ech", dispatch.astype(c.dtype),
+                           tokens)                    # [E, C, H]
+        up = jnp.einsum("ech,ehf->ecf", slots, w_up)
+        act = nn.gelu(up)
+        out = jnp.einsum("ecf,efh->ech", act,
+                         w_down).astype(jnp.float32)
+        y = jnp.einsum("ect,ech->th", combine, out)
+        return y.reshape(b, t, h).astype(x.dtype)
+
+
 class Block(nn.Module):
-    """Pre-LN transformer block (GPT-2 style)."""
+    """Pre-LN transformer block (GPT-2 style); dense or MoE FFN."""
 
     config: GPTConfig
 
@@ -105,9 +156,12 @@ class Block(nn.Module):
         y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         x = x + CausalSelfAttention(c)(y)
         y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
-        y = nn.Dense(c.intermediate_size, dtype=c.dtype)(y)
-        y = nn.gelu(y)
-        y = nn.Dense(c.hidden_size, dtype=c.dtype)(y)
+        if c.num_experts:
+            y = MoEMLP(c, name="moe")(y)
+        else:
+            y = nn.Dense(c.intermediate_size, dtype=c.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(c.hidden_size, dtype=c.dtype)(y)
         return x + y
 
 
@@ -158,3 +212,75 @@ def gpt_loss(logits, token_ids):
 
     return optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1].astype(jnp.float32), token_ids[:, 1:]).mean()
+
+
+def stack_gpt_blocks(params, num_stages: int):
+    """Host-side prep for pipeline parallelism: split a GPTLM param tree
+    into (outer, stacked) where `stacked` carries every Block's params
+    under a leading [num_stages, layers_per_stage] axis pair (shard the
+    first over the pipe mesh axis) and `outer` is everything else
+    (embeddings, final LayerNorm, lm_head — replicated; they run outside
+    the pipe)."""
+    from ..parallel.pipeline import stack_stage_params
+
+    names = sorted((k for k in params if k.startswith("Block_")),
+                   key=lambda k: int(k.split("_")[1]))
+    if len(names) % num_stages:
+        raise ValueError(
+            f"{len(names)} blocks do not divide {num_stages} stages")
+    per = len(names) // num_stages
+    blocks = [params[k] for k in names]
+    stacked = stack_stage_params(
+        [stack_stage_params(blocks[s * per:(s + 1) * per])
+         for s in range(num_stages)])
+    outer = {k: v for k, v in params.items()
+             if not k.startswith("Block_")}
+    return outer, stacked
+
+
+def gpt_pipeline_forward(cfg: GPTConfig, outer, stage_blocks, tokens,
+                         axis_name: str, num_microbatches: int):
+    """GPipe forward for GPT: runs INSIDE `shard_map` over `axis_name`.
+
+    - `outer`: the non-Block params from `stack_gpt_blocks`, replicated
+      (in_specs P()).
+    - `stage_blocks`: THIS stage's [layers_per_stage, ...] Block params
+      (in_specs P('pipe') on the stacked tree's leading axis).
+    - `tokens`: [B, T] with B % num_microbatches == 0, replicated.
+
+    Embeddings and the head run replicated on every device (cheap);
+    the Block stack streams microbatches stage-to-stage over ICI via
+    `parallel.pipeline.pipeline_apply`. Returns [B, T, vocab] logits,
+    replicated — differentiate the shard_mapped caller as usual.
+    """
+    from ..parallel.pipeline import pipeline_apply
+
+    b, t = tokens.shape
+    m = num_microbatches
+    if b % m:
+        raise ValueError(f"batch {b} % microbatches {m} != 0")
+    if t > cfg.max_position:
+        raise ValueError(f"sequence {t} exceeds max_position "
+                         f"{cfg.max_position}")
+    embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype)
+    pos_embed = nn.Embed(cfg.max_position, cfg.hidden_size,
+                         dtype=cfg.dtype)
+    x = embed.apply({"params": outer["wte"]}, tokens)
+    x = x + pos_embed.apply({"params": outer["wpe"]},
+                            jnp.arange(t)[None, :])
+    x = x.reshape(m, b // m, t, cfg.hidden_size)
+
+    def stage_fn(stacked, h):
+        def body(h, layer_params):
+            return Block(cfg).apply({"params": layer_params}, h), None
+
+        h, _ = lax.scan(body, h, stacked)
+        return h
+
+    x = pipeline_apply(stage_fn, stage_blocks, x, axis_name,
+                       num_microbatches=m)
+    x = x.reshape(b, t, cfg.hidden_size)
+    x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32).apply(
+        {"params": outer["LayerNorm_0"]}, x)
+    return nn.Dense(cfg.vocab_size, dtype=jnp.float32).apply(
+        {"params": outer["lm_head"]}, x)

@@ -21,11 +21,13 @@ from .mesh import (
 from .pair_host import PairAveragingHost
 from .sequence import (heads_to_seq, ring_attention, seq_to_heads,
                        ulysses_attention)
-from .expert import MoEParams, init_moe_params, moe_mlp
+from .expert import (MoEParams, dispatch_tensors, init_moe_params,
+                     moe_capacity, moe_mlp)
 from .pipeline import pipeline_apply, stack_stage_params
-from .tensor import bert_tp_rules, gpt_tp_rules, shard_params
-from .train import (build_eval_step, build_train_step,
-                    build_train_step_with_state)
+from .tensor import (bert_tp_rules, gpt_moe_rules, gpt_tp_rules,
+                     shard_params)
+from .train import (build_eval_step, build_gspmd_train_step,
+                    build_train_step, build_train_step_with_state)
 
 __all__ = [
     "data_mesh",
@@ -39,6 +41,9 @@ __all__ = [
     "build_train_step",
     "build_eval_step",
     "build_train_step_with_state",
+    "build_gspmd_train_step",
+    "dispatch_tensors",
+    "moe_capacity",
     "PairAveragingHost",
     "ring_attention",
     "ulysses_attention",
@@ -46,6 +51,7 @@ __all__ = [
     "heads_to_seq",
     "bert_tp_rules",
     "gpt_tp_rules",
+    "gpt_moe_rules",
     "shard_params",
     "moe_mlp",
     "init_moe_params",

@@ -62,7 +62,7 @@ def moe_capacity(tokens: int, capacity_factor: float,
     return max(1, -(-int(tokens * capacity_factor) // num_experts))
 
 
-def _dispatch_tensors(x, router, num_experts: int, capacity: int):
+def dispatch_tensors(x, router, num_experts: int, capacity: int):
     """Switch top-1 routing on local tokens x [T, H].
 
     Returns (dispatch [E, C, T] one-hot-ish, combine [E, C, T] prob-
@@ -105,7 +105,7 @@ def moe_mlp(
     num_experts = local_e * p
     capacity = moe_capacity(t, capacity_factor, num_experts)
 
-    dispatch, combine = _dispatch_tensors(x, params.router, num_experts,
+    dispatch, combine = dispatch_tensors(x, params.router, num_experts,
                                           capacity)
     # gather local tokens into expert slots: [E, C, H]
     slots = jnp.einsum("ect,th->ech", dispatch, x.astype(jnp.float32))

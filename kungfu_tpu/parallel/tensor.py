@@ -66,6 +66,18 @@ def gpt_tp_rules(axis: str = "model") -> Rules:
     return _megatron_rules("Block", axis)
 
 
+def gpt_moe_rules(axis: str = "model") -> Rules:
+    """Expert sharding for `models.gpt.MoEMLP`'s global stacks, composed
+    with the Megatron split: expert stacks [E, H, F] shard their expert
+    dim over `axis`, the router stays replicated, and the non-MoE rules
+    apply to attention. GSPMD lowers the dispatch/combine einsums to
+    all-to-alls across the expert shards."""
+    return (
+        (r".*moe.*w_(up|down)", P(axis, None, None)),
+        (r".*moe.*router", P()),
+    ) + gpt_tp_rules(axis)
+
+
 def _path_str(path) -> str:
     return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
                     for p in path)

@@ -109,6 +109,31 @@ def build_train_step(
     return step
 
 
+def build_gspmd_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    donate: bool = True,
+):
+    """Compile a train step for the GSPMD (annotation-sharded) layout.
+
+    The shard_map builders above use the worker-stacked DP layout; this
+    one is for models whose params carry `NamedSharding`s directly
+    (`parallel.tensor.shard_params` dp x tp / MoE) — no stacking, no
+    explicit collectives: `loss_fn(params, batch) -> scalar`, and GSPMD
+    schedules everything from the placements. Returns
+    `step(params, opt_state, batch) -> (params, opt_state, loss)` with
+    params+opt donated (without donation XLA double-buffers the full
+    f32 state — ~4.2 GB extra for GPT-2-medium + adamw).
+    """
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
 def build_eval_step(
     metric_fn: Callable, mesh: Mesh, axis_name: str = "data"
 ):

@@ -16,7 +16,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from kungfu_tpu.parallel.expert import (
     MoEParams,
-    _dispatch_tensors,
+    dispatch_tensors,
     init_moe_params,
     moe_capacity,
     moe_mlp,
@@ -26,7 +26,7 @@ from kungfu_tpu.parallel.expert import (
 # test-only oracle: same routing math, all experts local (kept here next
 # to its only callers so it can't drift silently inside the package)
 def moe_mlp_reference(x, params_full, num_experts, capacity):
-    dispatch, combine = _dispatch_tensors(x, params_full.router,
+    dispatch, combine = dispatch_tensors(x, params_full.router,
                                           num_experts, capacity)
     slots = jnp.einsum("ect,th->ech", dispatch, x.astype(jnp.float32))
     up = jnp.einsum("ech,ehf->ecf", slots,
