@@ -31,7 +31,8 @@ from .peer import Peer
 def __getattr__(name):
     # lazy: checkpoint pulls in jax, which the jax-free control-plane
     # path (the kfrun launcher) must not pay for at startup
-    if name in ("save_checkpoint", "load_checkpoint", "flatten_tree"):
+    if name in ("save_checkpoint", "load_checkpoint", "flatten_tree",
+                "OrbaxCheckpointManager"):
         from . import checkpoint
 
         attr = getattr(checkpoint, name)

@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -51,6 +52,12 @@ class GPTConfig:
     # dispatch/combine einsums to all-to-alls — no shard_map needed.
     num_experts: int = 0
     moe_capacity_factor: float = 1.25
+    # routing group size (GShard/Switch): tokens route within fixed-size
+    # groups so dispatch/combine tensors stay LINEAR in total tokens
+    # (~cf * group entries per token) instead of quadratic. 0 = auto
+    # (512, shrunk to fit); groups that don't divide B*T fall back to
+    # one group per batch row.
+    moe_group_size: int = 0
 
     def __post_init__(self):
         if self.attention not in _ATTN_MODES:
@@ -129,19 +136,29 @@ class MoEMLP(nn.Module):
         w_down = self.param(
             "w_down", nn.initializers.normal(f ** -0.5), (e, f, h),
             jnp.float32).astype(c.dtype)
-        tokens = x.reshape(b * t, h)
-        capacity = moe_capacity(b * t, c.moe_capacity_factor, e)
-        dispatch, combine = dispatch_tensors(
-            tokens, router, e, capacity)              # [E, C, BT] f32
+        # GShard-style grouped routing: dispatch/combine are
+        # [G, E, C, group] with C = ceil(group*cf/E), so total entries
+        # are ~cf * group per token — linear in B*T, bounded by the
+        # group size — instead of the quadratic [E, ceil(B*T*cf/E), B*T]
+        # a single global group would cost.
+        group = min(c.moe_group_size or 512, b * t)
+        if (b * t) % group:
+            group = t  # per-row groups always divide
+        n_groups = (b * t) // group
+        tokens = x.reshape(n_groups, group, h)
+        capacity = moe_capacity(group, c.moe_capacity_factor, e)
+        dispatch, combine = jax.vmap(
+            lambda tg: dispatch_tensors(tg, router, e, capacity))(
+            tokens)                                  # [G, E, C, g] f32
         # gather in the param dtype (dispatch entries are exact 0/1);
         # gate-weighted combine stays f32 like parallel.expert.moe_mlp
-        slots = jnp.einsum("ect,th->ech", dispatch.astype(c.dtype),
-                           tokens)                    # [E, C, H]
-        up = jnp.einsum("ech,ehf->ecf", slots, w_up)
+        slots = jnp.einsum("gect,gth->gech", dispatch.astype(c.dtype),
+                           tokens)                    # [G, E, C, H]
+        up = jnp.einsum("gech,ehf->gecf", slots, w_up)
         act = nn.gelu(up)
-        out = jnp.einsum("ecf,efh->ech", act,
+        out = jnp.einsum("gecf,efh->gech", act,
                          w_down).astype(jnp.float32)
-        y = jnp.einsum("ect,ech->th", combine, out)
+        y = jnp.einsum("gect,gech->gth", combine, out)
         return y.reshape(b, t, h).astype(x.dtype)
 
 

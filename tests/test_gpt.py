@@ -270,6 +270,29 @@ class TestMoE:
 
 
 
+    def test_grouped_routing_matches_per_group_oracle(self):
+        """moe_group_size splits routing into independent groups; each
+        group must equal running the single-group module on it alone."""
+        from kungfu_tpu.models.gpt import MoEMLP
+
+        c = GPTConfig(**{**self.CFG_MOE.__dict__, "moe_group_size": 8})
+        single = GPTConfig(**{**self.CFG_MOE.__dict__,
+                              "moe_group_size": 0})
+        x = jax.random.normal(jax.random.PRNGKey(0),
+                              (2, 16, c.hidden_size))  # 4 groups of 8
+        mod = MoEMLP(c)
+        params = mod.init(jax.random.PRNGKey(1), x)["params"]
+        out = mod.apply({"params": params}, x)
+
+        ref_mod = MoEMLP(single)
+        toks = np.asarray(x).reshape(-1, 8, c.hidden_size)
+        refs = [np.asarray(ref_mod.apply(
+            {"params": params}, jnp.asarray(g)[None]))[0]
+            for g in toks]
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(-1, 8, c.hidden_size),
+            np.stack(refs), rtol=1e-5, atol=1e-5)
+
 
 class TestPipelineParallel:
     """GPipe-composed GPT: per-stage Block stacks vs the plain model."""
