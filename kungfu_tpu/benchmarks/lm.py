@@ -85,6 +85,46 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     return global_tokens / dt, meta
 
 
+def measure_decode_rate(size: str = "small", batch: int = 8,
+                        prompt_len: int = 128, gen_len: int = 128,
+                        iters: int = 3):
+    """Generated tokens/sec of KV-cached autoregressive decoding."""
+    import jax
+    import jax.numpy as jnp
+
+    from kungfu_tpu.models import GPTConfig, GPTLM, gpt_generate
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":  # smoke path
+        size, batch, prompt_len, gen_len = "tiny", 2, 8, 8
+        iters = 1
+    hidden, layers, heads, inter = SIZES[size]
+    cfg = GPTConfig(vocab_size=50257, hidden_size=hidden,
+                    num_layers=layers, num_heads=heads,
+                    intermediate_size=inter,
+                    max_position=prompt_len + gen_len,
+                    dtype=jnp.bfloat16)
+    model = GPTLM(cfg)
+    prompt = jnp.zeros((batch, prompt_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    run = jax.jit(lambda p, t: gpt_generate(model, p, t, gen_len))
+    out = run(params, prompt)            # compile + warmup
+    int(out[0, -1])                      # fence
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run(params, prompt)
+        int(out[0, -1])
+    dt = (time.perf_counter() - t0) / iters
+    # the timed region is one batched prefill forward + gen_len decode
+    # steps; ms_per_token divides by gen_len, so it slightly overstates
+    # per-decode-step cost by the (single) prefill pass
+    meta = {"platform": platform, "size": size, "batch": batch,
+            "prompt_len": prompt_len, "gen_len": gen_len,
+            "ms_per_token": round(dt * 1000 / gen_len, 3)}
+    return batch * gen_len / dt, meta
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default="small", choices=sorted(SIZES))
@@ -95,7 +135,26 @@ def main():
     ap.add_argument("--attention", default="local",
                     choices=["local", "flash"])
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--decode", action="store_true",
+                    help="measure KV-cached generation instead of "
+                         "training")
+    ap.add_argument("--prompt-len", type=int, default=128,
+                    help="(--decode) prompt length")
+    ap.add_argument("--gen-len", type=int, default=128,
+                    help="(--decode) generated tokens")
     args = ap.parse_args()
+    if args.decode:
+        if args.tp != 1 or args.attention != "local":
+            raise SystemExit(
+                "--decode supports tp=1 local attention only; "
+                "--tp/--attention do not apply")
+        rate, meta = measure_decode_rate(args.size, args.batch,
+                                         args.prompt_len, args.gen_len,
+                                         iters=args.iters)
+        print(json.dumps({"metric": "gpt_decode_tokens_per_sec",
+                          "value": round(rate, 1),
+                          "unit": "tokens/sec", "details": meta}))
+        return
     rate, meta = measure_lm_rate(args.size, args.batch, args.seq,
                                  args.tp, args.attention, args.iters)
     print(json.dumps({"metric": "gpt_tokens_per_sec",

@@ -79,13 +79,72 @@ class CausalSelfAttention(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False, prefill: bool = False):
         c = self.config
         h, d = c.num_heads, c.hidden_size // c.num_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (h, d), dtype=c.dtype, name=name)
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
-        if c.attention == "local":
+        if prefill:
+            # one batched causal pass over the whole prompt that ALSO
+            # fills the KV cache — time-to-first-token is one forward,
+            # not T0 sequential decode steps
+            is_initialized = self.has_variable("cache", "k")
+            b, t0 = x.shape[0], x.shape[1]
+            ck = self.variable("cache", "k", jnp.zeros,
+                               (b, c.max_position, h, d), c.dtype)
+            cv = self.variable("cache", "v", jnp.zeros,
+                               (b, c.max_position, h, d), c.dtype)
+            idx = self.variable("cache", "index",
+                                lambda: jnp.zeros((), jnp.int32))
+            if is_initialized:
+                ck.value = lax.dynamic_update_slice(ck.value, k,
+                                                    (0, 0, 0, 0))
+                cv.value = lax.dynamic_update_slice(cv.value, v,
+                                                    (0, 0, 0, 0))
+                idx.value = jnp.asarray(t0, jnp.int32)
+            mask = nn.make_causal_mask(jnp.zeros((1, t0)))
+            out = nn.dot_product_attention(q, k, v, mask=mask,
+                                           dtype=c.dtype)
+        elif decode:
+            # KV-cached single-token decode: x is [B, 1, H]; append this
+            # step's k/v at the cache cursor and attend over the filled
+            # prefix. Cache layout [B, max_position, H, D] — static
+            # shapes, so the per-token step jits once. Note the
+            # has_variable check BEFORE creating the variables: init()
+            # also executes this body, and without the guard it would
+            # pollute the fresh cache with the init params' k/v and a
+            # bumped cursor (flax's own decode path uses the same
+            # idiom).
+            is_initialized = self.has_variable("cache", "k")
+            b = x.shape[0]
+            ck = self.variable("cache", "k", jnp.zeros,
+                               (b, c.max_position, h, d), c.dtype)
+            cv = self.variable("cache", "v", jnp.zeros,
+                               (b, c.max_position, h, d), c.dtype)
+            idx = self.variable("cache", "index",
+                                lambda: jnp.zeros((), jnp.int32))
+            if not is_initialized:
+                out = v  # init pass: only shapes matter
+            else:
+                i = idx.value
+                ck.value = lax.dynamic_update_slice(ck.value, k,
+                                                    (0, i, 0, 0))
+                cv.value = lax.dynamic_update_slice(cv.value, v,
+                                                    (0, i, 0, 0))
+                idx.value = i + 1
+                # only positions <= cursor are visible
+                visible = (jnp.arange(c.max_position)
+                           <= i)[None, None, None]
+                s = jnp.einsum("bqhd,bkhd->bhqk",
+                               q.astype(jnp.float32),
+                               ck.value.astype(jnp.float32)) * (d ** -0.5)
+                s = jnp.where(visible, s, jnp.finfo(jnp.float32).min)
+                w = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("bhqk,bkhd->bqhd", w,
+                                 cv.value.astype(jnp.float32)
+                                 ).astype(c.dtype)
+        elif c.attention == "local":
             t = x.shape[-2]
             mask = nn.make_causal_mask(jnp.zeros((1, t)))
             out = nn.dot_product_attention(q, k, v, mask=mask,
@@ -168,10 +227,11 @@ class Block(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False, prefill: bool = False):
         c = self.config
         y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
-        x = x + CausalSelfAttention(c)(y)
+        x = x + CausalSelfAttention(c)(y, decode=decode,
+                                       prefill=prefill)
         y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         if c.num_experts:
             y = MoEMLP(c, name="moe")(y)
@@ -188,10 +248,37 @@ class GPTLM(nn.Module):
     config: GPTConfig = GPTConfig()  # frozen dataclass: hashable default
 
     @nn.compact
-    def __call__(self, token_ids):
+    def __call__(self, token_ids, decode: bool = False,
+                 prefill: bool = False):
         c = self.config
         local_len = token_ids.shape[-1]
-        if c.attention in ("ring", "ulysses"):
+        if prefill:
+            # batched prompt pass that fills the KV caches: normal
+            # causal positions, cursor jumps to the prompt length
+            if local_len > c.max_position:
+                raise ValueError(
+                    f"prompt {local_len} exceeds max_position "
+                    f"{c.max_position}")
+            initialized = self.has_variable("cache", "position")
+            pos_var = self.variable("cache", "position",
+                                    lambda: jnp.zeros((), jnp.int32))
+            pos = jnp.arange(local_len)[None, :]
+            if initialized:
+                pos_var.value = jnp.asarray(local_len, jnp.int32)
+        elif decode:
+            # KV-cached decode: one token per call; the position cursor
+            # lives in the cache collection next to each layer's k/v
+            if local_len != 1:
+                raise ValueError(
+                    f"decode processes one token per call, got "
+                    f"{local_len}")
+            initialized = self.has_variable("cache", "position")
+            pos_var = self.variable("cache", "position",
+                                    lambda: jnp.zeros((), jnp.int32))
+            pos = pos_var.value[None, None]
+            if initialized:  # init() must return a pristine cursor
+                pos_var.value = pos_var.value + 1
+        elif c.attention in ("ring", "ulysses"):
             # sequence-sharded: this device holds positions
             # [rank*local_len, (rank+1)*local_len)
             global_len = local_len * lax.axis_size(c.seq_axis)
@@ -212,7 +299,7 @@ class GPTLM(nn.Module):
         x = x + nn.Embed(c.max_position, c.hidden_size, dtype=c.dtype,
                          name="wpe")(pos)
         for _ in range(c.num_layers):
-            x = Block(c)(x)
+            x = Block(c)(x, decode=decode, prefill=prefill)
         x = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         return nn.Dense(c.vocab_size, dtype=jnp.float32,
                         name="lm_head")(x)
@@ -229,6 +316,63 @@ def gpt_loss(logits, token_ids):
 
     return optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1].astype(jnp.float32), token_ids[:, 1:]).mean()
+
+
+def gpt_generate(model: GPTLM, params, prompt, num_steps: int,
+                 rng=None, temperature: float = 0.0):
+    """Autoregressive generation with a KV cache.
+
+    `prompt` [B, T0] int tokens; returns [B, T0 + num_steps]. The cache
+    holds [B, max_position, H, D] per layer, so every decode step is the
+    SAME jitted program (static shapes, one compile) — the standard TPU
+    serving pattern. `temperature=0` is greedy argmax; otherwise sample
+    with `rng` (required).
+    """
+    c = model.config
+    b, t0 = prompt.shape
+    if t0 + num_steps > c.max_position:
+        raise ValueError(
+            f"prompt {t0} + steps {num_steps} exceeds max_position "
+            f"{c.max_position}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature != 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+
+    # the cache is all zeros with statically-known shapes — build it
+    # from eval_shape instead of paying a full (discarded) param init
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), prompt[:, :1],
+                           decode=True))
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
+
+    def sample(logits, key):  # [B, V] -> [B]
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(key, logits / temperature,
+                                      axis=-1)
+
+    # batched prefill: ONE causal forward over the prompt fills every
+    # layer's cache and yields the first new-token logits
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, prompt, prefill=True,
+        mutable=["cache"])
+    cache = mut["cache"]
+    keys = jax.random.split(rng if rng is not None
+                            else jax.random.PRNGKey(0), num_steps)
+    tok0 = sample(logits[:, -1], keys[0])
+
+    def gen(carry, key):
+        cache, tok = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            decode=True, mutable=["cache"])
+        nxt = sample(logits[:, 0], key)
+        return (mut["cache"], nxt), nxt
+
+    _, toks = lax.scan(gen, (cache, tok0), keys[1:])
+    return jnp.concatenate([prompt, tok0[:, None], toks.T], axis=1)
 
 
 def stack_gpt_blocks(params, num_stages: int):

@@ -369,3 +369,58 @@ class TestPipelineParallel:
         params = model.init(jax.random.PRNGKey(1), tokens)["params"]
         with pytest.raises(ValueError, match="divide"):
             stack_gpt_blocks(params, 3)
+
+
+class TestGenerate:
+    """KV-cached decoding vs full-recompute argmax — exact parity."""
+
+    def test_greedy_matches_full_recompute(self):
+        """Token-exact parity is safe here: the suite pins the CPU
+        backend (conftest), where both paths' f32 math is
+        deterministic; on accelerators compare logits with a tolerance
+        instead (contraction orders differ at the last ulp)."""
+        from kungfu_tpu.models import gpt_generate
+
+        model, params, _ = make()
+        prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 5), 0,
+                                    CFG.vocab_size)
+        out = gpt_generate(model, params, prompt, num_steps=6)
+        assert out.shape == (2, 11)
+        np.testing.assert_array_equal(np.asarray(out[:, :5]),
+                                      np.asarray(prompt))
+        # oracle: grow the sequence one token at a time, full forward
+        seq = prompt
+        for _ in range(6):
+            logits = model.apply({"params": params}, seq)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+    def test_single_token_prompt(self):
+        from kungfu_tpu.models import gpt_generate
+
+        model, params, _ = make()
+        prompt = jnp.asarray([[3]], jnp.int32)
+        out = gpt_generate(model, params, prompt, num_steps=4)
+        assert out.shape == (1, 5)
+
+    def test_sampling_requires_rng_and_differs(self):
+        from kungfu_tpu.models import gpt_generate
+
+        model, params, _ = make()
+        prompt = jnp.asarray([[3, 7, 1]], jnp.int32)
+        with pytest.raises(ValueError, match="rng"):
+            gpt_generate(model, params, prompt, 4, temperature=1.0)
+        a = gpt_generate(model, params, prompt, 8, temperature=2.0,
+                         rng=jax.random.PRNGKey(0))
+        b = gpt_generate(model, params, prompt, 8, temperature=2.0,
+                         rng=jax.random.PRNGKey(1))
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_overflow_guard(self):
+        from kungfu_tpu.models import gpt_generate
+
+        model, params, _ = make()
+        prompt = jnp.zeros((1, CFG.max_position - 2), jnp.int32)
+        with pytest.raises(ValueError, match="max_position"):
+            gpt_generate(model, params, prompt, num_steps=5)
