@@ -21,6 +21,7 @@ from .mesh import (
 from .pair_host import PairAveragingHost
 from .sequence import (heads_to_seq, ring_attention, seq_to_heads,
                        ulysses_attention)
+from .bootstrap import init_distributed, shutdown_distributed
 from .expert import (MoEParams, dispatch_tensors, init_moe_params,
                      moe_capacity, moe_mlp)
 from .pipeline import pipeline_apply, stack_stage_params
@@ -42,6 +43,8 @@ __all__ = [
     "build_eval_step",
     "build_train_step_with_state",
     "build_gspmd_train_step",
+    "init_distributed",
+    "shutdown_distributed",
     "dispatch_tensors",
     "moe_capacity",
     "PairAveragingHost",

@@ -1,0 +1,105 @@
+"""Multi-host JAX runtime bootstrap from the kfrun environment.
+
+On a TPU pod, every kfrun-spawned worker must join ONE global JAX
+runtime before building meshes: `jax.distributed.initialize` wires the
+processes together so `jax.devices()` spans the whole slice and
+`jax.sharding.Mesh` axes can ride ICI/DCN. The reference needs no such
+step (its Go runtime owns all communication); here the data plane is
+XLA's, so the launcher env (KF_SELF_SPEC / KF_INIT_PEERS — env.py) is
+mapped onto the jax.distributed contract:
+
+- process_id  = this worker's rank in the peer list
+- num_processes = peer-list size
+- coordinator = rank 0's host, on its control port + a fixed offset
+  (the control port itself belongs to libkf's transport)
+
+Single-process configs (no KF_SELF_SPEC, or a 1-peer list) are a no-op,
+so programs keep working standalone — the same fallback contract as
+`env.from_env`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .. import env as kf_env
+
+# the jax.distributed coordinator listens beside the control plane; the
+# offset keeps it clear of libkf's port (worker ports are <= 0xFFFF -
+# offset in every kfrun port range)
+COORDINATOR_PORT_OFFSET = 2000
+
+
+def coordinator_address(cfg: "kf_env.Config") -> str:
+    """rank-0's host:port+offset — identical on every process."""
+    p0 = cfg.init_peers[0]
+    port = p0.port + COORDINATOR_PORT_OFFSET
+    if port > 0xFFFF:
+        raise ValueError(
+            f"coordinator port {port} exceeds 65535: rank 0's control "
+            f"port {p0.port} is too high for the +"
+            f"{COORDINATOR_PORT_OFFSET} offset — use a -port-range "
+            f"below {0xFFFF - COORDINATOR_PORT_OFFSET}")
+    return f"{p0.host}:{port}"
+
+
+# what this process initialized against: (coordinator, n, rank)
+_initialized: Optional[Tuple[str, int, int]] = None
+
+
+def init_distributed(
+    config: Optional["kf_env.Config"] = None,
+    local_device_ids=None,
+) -> Tuple[int, int]:
+    """Join the global JAX runtime described by the KF_* env.
+
+    Returns (process_id, num_processes). No-op (0, 1) for standalone
+    runs. `local_device_ids` narrows which local devices this process
+    contributes (kfrun's chip-slot assignment already scopes visibility
+    via env, so it is rarely needed).
+
+    Elastic caveat: the peer list is bound ONCE per process.
+    jax.distributed cannot follow a live membership change — on a resize
+    epoch, survivors must call `shutdown_distributed()` before
+    re-initializing against the new peer list (and the whole cluster
+    must do so together, it is a collective boundary). Calling this
+    again with a DIFFERENT cluster while initialized raises instead of
+    deadlocking the joiner against survivors stuck on the old
+    coordinator.
+    """
+    global _initialized
+    cfg = config or kf_env.from_env()
+    n = len(cfg.init_peers)
+    if cfg.single_process or n <= 1:
+        return 0, 1
+    rank = cfg.rank
+    target = (coordinator_address(cfg), n, rank)
+    if _initialized is not None:
+        if _initialized == target:
+            return rank, n  # idempotent re-entry
+        raise RuntimeError(
+            f"jax.distributed already initialized against "
+            f"{_initialized}; a resized cluster needs "
+            f"shutdown_distributed() first (epoch boundary), got "
+            f"{target}")
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=target[0],
+        num_processes=n,
+        process_id=rank,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = target
+    return rank, n
+
+
+def shutdown_distributed() -> None:
+    """Leave the global runtime (resize-epoch boundary helper)."""
+    global _initialized
+    if _initialized is None:
+        return
+    import jax
+
+    jax.distributed.shutdown()
+    _initialized = None

@@ -33,8 +33,9 @@ def data_mesh(
 ) -> Mesh:
     """A 1-D mesh over the first `num_devices` visible devices.
 
-    On a TPU pod slice, call after `jax.distributed.initialize()` (kfrun
-    does this) so `jax.devices()` spans all hosts. Pass `devices`
+    On a TPU pod slice, call after `parallel.init_distributed()` (which
+    maps the kfrun KF_* env onto jax.distributed.initialize) so
+    `jax.devices()` spans all hosts. Pass `devices`
     explicitly to pin the mesh to a specific backend (the multi-chip dry
     run pins virtual CPU devices this way so it never executes on whatever
     platform owns the default backend). Without `devices` a short visible
