@@ -140,9 +140,12 @@ def flash_attention(
 
     Tiling requires T % block == 0 (and causal additionally
     block_q % block_k == 0); other shapes use the plain implementation.
-    `block_q`/`block_k` default to auto: the largest power-of-two <= 512
-    dividing T (fastest measured on v5e). `interpret=None` auto-selects
-    interpreter mode off-TPU so tests run on the CPU mesh.
+    `block_q`/`block_k` default to auto: T <= 512 runs as ONE block
+    (any length — full-dim blocks always satisfy Mosaic's tiling rule;
+    odd lengths verified on real v5e), longer T picks the largest of
+    512/256/128 dividing it (512 fastest measured on v5e), and longer
+    non-dividing T takes the plain fallback. `interpret=None`
+    auto-selects interpreter mode off-TPU so tests run on the CPU mesh.
 
     Backward pass: fused flash backward kernels — the forward saves only
     (q, k, v, o, lse), and dq/dk/dv are computed blockwise with the

@@ -195,3 +195,16 @@ class TestFlashBackwardKernels:
                 scale = float(jnp.max(jnp.abs(b)))
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=0, atol=2e-4 * scale)
+
+
+def test_explicit_nondividing_blocks_fall_back():
+    """Explicit block sizes that don't divide T must take the plain
+    fallback (auto-mode tests no longer exercise this branch)."""
+    from kungfu_tpu.ops.flash import _tiles
+
+    assert _tiles(100, False, 64, 64) is None
+    q, k, v = qkv(t=100)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = _plain_attention(q, k, v, True, 1.0 / (32 ** 0.5))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
