@@ -52,6 +52,13 @@ class GPTConfig:
     # dispatch/combine einsums to all-to-alls — no shard_map needed.
     num_experts: int = 0
     moe_capacity_factor: float = 1.25
+    # attention="ulysses" only: run the per-head-subset local mixer
+    # through the Pallas flash kernel. FORWARD/INFERENCE only for now:
+    # an upstream JAX bug miscompiles grads through all_to_all around a
+    # custom_vjp inside shard_map (tests/test_flash.py xfail). For
+    # long-context TRAINING use attention="flash" (no shard_map;
+    # fastest measured) or "ring".
+    use_flash: bool = False
     # routing group size (GShard/Switch): tokens route within fixed-size
     # groups so dispatch/combine tensors stay LINEAR in total tokens
     # (~cf * group entries per token) instead of quadratic. 0 = auto
@@ -67,6 +74,11 @@ class GPTConfig:
         if self.hidden_size % self.num_heads:
             raise ValueError(
                 f"hidden {self.hidden_size} % heads {self.num_heads} != 0")
+        if self.use_flash and self.attention != "ulysses":
+            raise ValueError(
+                "use_flash only modifies the 'ulysses' local mixer; for "
+                f"attention={self.attention!r} use attention='flash' "
+                "instead (the non-sharded flash mode)")
 
 
 class CausalSelfAttention(nn.Module):
@@ -159,9 +171,12 @@ class CausalSelfAttention(nn.Module):
                 ulysses_attention,
             )
 
-            mixer = (ring_attention if c.attention == "ring"
-                     else ulysses_attention)
-            out = mixer(q, k, v, c.seq_axis, causal=True)
+            if c.attention == "ring":
+                out = ring_attention(q, k, v, c.seq_axis, causal=True)
+            else:
+                out = ulysses_attention(q, k, v, c.seq_axis,
+                                        causal=True,
+                                        use_flash=c.use_flash)
         return nn.DenseGeneral(c.hidden_size, axis=(-2, -1),
                                dtype=c.dtype, name="out")(out)
 

@@ -395,18 +395,29 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     out, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
                                interpret, save_lse=True)
-    # lse is None on the fallback path -> plain VJP in _flash_bwd
-    return out, (q, k, v, out if lse is not None else None, lse)
+    if lse is None:  # fallback path (statically decidable from shapes)
+        return out, (q, k, v)
+    # The residual is carried as [B, T, H, 1] — the same
+    # batch/sequence/head layout as q/k/v/o — NOT the kernel's [B*H, T],
+    # and the residual tuple carries NO None sentinels: both confuse
+    # `shard_map(..., check_vma=False)` grad residual handling (the
+    # hoisted residual gets mis-wired and downstream reshapes see the
+    # lse where the output should be — see test_ulysses_flash_grads).
+    b, t, h, d = q.shape
+    lse4 = _unbh(lse[..., None], b, h)  # [B*H, T, 1] -> [B, T, H, 1]
+    return out, (q, k, v, out, lse4)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, o, lse = res
+    q, k, v = res[0], res[1], res[2]
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    if lse is None:  # shapes didn't tile: mirror the fallback forward
+    if len(res) == 3:  # shapes didn't tile: mirror the fallback forward
         _, vjp = jax.vjp(lambda q, k, v: _plain_attention(q, k, v, causal,
                                                           scale), q, k, v)
         return vjp(g)
+    o, lse4 = res[3], res[4]
+    lse = _bh(lse4)[..., 0]  # [B, T, H, 1] -> [B*H, T]
     return _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q,
                            block_k, interpret)
 

@@ -208,3 +208,51 @@ def test_explicit_nondividing_blocks_fall_back():
     ref = _plain_attention(q, k, v, True, 1.0 / (32 ** 0.5))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.xfail(
+    reason="upstream JAX bug: differentiating through all_to_all "
+           "(tiled=False) around a custom_vjp inside "
+           "shard_map(check_vma=False) miscompiles (MLIR reshape "
+           "element-count mismatch). The PLAIN ulysses path under "
+           "check_vma=True hits the same verifier error, so this is "
+           "not specific to the pallas kernel. Long-context TRAINING "
+           "uses GPTConfig(attention='flash') (no shard_map; fastest "
+           "measured) or ring attention; ulysses+flash is "
+           "forward/inference-only until the fix.",
+    raises=ValueError, strict=True)
+def test_ulysses_flash_grads_match_plain():
+    """The long-context TRAINING composition: gradients flow through
+    the flash kernel inside the Ulysses shard_map and match the plain
+    local-mixer run."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from kungfu_tpu.parallel import ulysses_attention
+
+    b, t, h, d = 1, 256, 16, 32
+    ks = jax.random.split(jax.random.PRNGKey(7), 4)
+    q, k, v = (jax.random.normal(kk, (b, t, h, d)) for kk in ks[:3])
+    g = jax.random.normal(ks[3], (b, t, h, d))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+
+    def grads(use_flash):
+        fn = shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, "seq", causal=True, use_flash=use_flash),
+            mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"), check_vma=False)
+
+        def loss(q, k, v):
+            return jnp.vdot(fn(q, k, v), g)
+
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    with jax.default_matmul_precision("highest"):
+        gf = grads(True)
+        gp = grads(False)
+    for name, a, b_ in zip("dq dk dv".split(), gf, gp):
+        scale = float(jnp.max(jnp.abs(b_)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=0, atol=2e-4 * scale,
+                                   err_msg=name)
