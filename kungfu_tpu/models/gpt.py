@@ -74,11 +74,12 @@ class GPTConfig:
         if self.hidden_size % self.num_heads:
             raise ValueError(
                 f"hidden {self.hidden_size} % heads {self.num_heads} != 0")
-        if self.use_flash and self.attention != "ulysses":
+        if self.use_flash and self.attention not in ("ulysses", "flash"):
             raise ValueError(
                 "use_flash only modifies the 'ulysses' local mixer; for "
                 f"attention={self.attention!r} use attention='flash' "
-                "instead (the non-sharded flash mode)")
+                "instead (the non-sharded flash mode, where the flag is "
+                "redundant but accepted)")
 
 
 class CausalSelfAttention(nn.Module):

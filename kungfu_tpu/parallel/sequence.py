@@ -9,7 +9,10 @@ devices, and attention — the one op that mixes positions — runs either
   accumulated online (flash-attention-style running max/denominator), so
   no device ever materializes full [T, T] scores or the full K/V
   (Ring Attention, Liu et al. 2023). Communication rides the ICI ring —
-  exactly the topology `ppermute` maps to on TPU.
+  exactly the topology `ppermute` maps to on TPU. The per-hop
+  accumulate is `jax.checkpoint`ed, so the BACKWARD recomputes each
+  hop's scores instead of saving all p of them — training memory is
+  O(one hop), the same trade the flash kernel makes.
 - `seq_to_heads` / `heads_to_seq`: DeepSpeed-Ulysses layout switches via
   `lax.all_to_all` — attention itself then runs fully local with heads
   sharded, which is cheaper when heads >= devices and the sequence is
@@ -84,15 +87,21 @@ def ring_attention(
             s = jnp.where(mask[None, None], s, neg)
         return _online_block(o, m, l, s, v_blk)
 
+    # remat: the backward recomputes each hop's [B, H, Ts, Ts] scores
+    # instead of saving all p of them — training memory stays O(one
+    # hop) like the flash kernel's recompute trade, at ~1 extra QK^T
+    # matmul per hop
+    _ckpt_accumulate = jax.checkpoint(accumulate)
+
     def _maybe_accumulate(i, o, m, l, k_blk, v_blk):
         if not causal:
-            return accumulate(i, o, m, l, k_blk, v_blk)
+            return _ckpt_accumulate(i, o, m, l, k_blk, v_blk)
         # a block entirely above the diagonal (src > rank) is fully
         # masked: skip its einsum/exp, not just its contribution
         src = (rank - i) % p
         return lax.cond(
             src <= rank,
-            lambda o, m, l: accumulate(i, o, m, l, k_blk, v_blk),
+            lambda o, m, l: _ckpt_accumulate(i, o, m, l, k_blk, v_blk),
             lambda o, m, l: (o, m, l),
             o, m, l,
         )
