@@ -153,3 +153,49 @@ class TestGraftEntry:
             cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "DRYRUN_GREEN" in proc.stdout
+
+    def test_placement_audit_catches_stray_arrays(self):
+        """The audit inside dryrun_multichip must FAIL on any array that
+        lands off the dryrun platform — even when that platform is healthy
+        and the op succeeds (round 2's failure mode: a stray eager op on
+        the default TPU backend succeeded locally but crashed on the
+        driver host's mid-upgrade libtpu)."""
+        m = self.load()
+        devices = jax.devices("cpu")[:2]
+        baseline = list(jax.live_arrays())  # strong refs, like dryrun
+        x = jnp.ones((4,))  # on-platform array: audit stays green
+        m._audit_placements(devices, baseline, "unit")
+        # Simulate a foreign-platform dryrun: with allowed={tpu-like}, the
+        # CPU-resident array above must trip the audit exactly as a
+        # TPU-resident array would trip it for a CPU dryrun.
+        class FakeDev:
+            platform = "tpu"
+        with pytest.raises(AssertionError, match="off the dryrun platform"):
+            m._audit_placements([FakeDev()], baseline, "unit")
+        del x
+
+    def test_dryrun_devices_probe_rejects_unusable_accelerator(self):
+        """A backend that can LIST devices but cannot EXECUTE (the driver
+        host's broken libtpu) must be rejected by the probe, falling back
+        to virtual CPU devices instead of crashing mid-dryrun."""
+        m = self.load()
+
+        class BrokenDevice:
+            platform = "fake_accel"
+
+        real_devices = jax.devices
+
+        def fake_devices(platform=None):
+            if platform is None:
+                return [BrokenDevice() for _ in range(4)] + real_devices(
+                    "cpu")
+            return real_devices(platform)
+
+        m.jax.devices = fake_devices
+        try:
+            # device_put onto the fake device raises -> probe fails ->
+            # CPU fallback
+            devs = m._dryrun_devices(4)
+        finally:
+            m.jax.devices = real_devices
+        assert all(d.platform == "cpu" for d in devs)
