@@ -25,6 +25,8 @@ and `lax.fori_loop` control flow so XLA compiles one program per device.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -57,6 +59,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     scale: float | None = None,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
@@ -65,7 +68,18 @@ def ring_attention(
     [r*Ts, (r+1)*Ts)). Returns the attention output for the local Q
     shard, [B, Ts, H, D]. Peak memory is O(Ts^2) scores per step and one
     in-flight K/V block — never the full sequence.
+
+    `use_flash` routes each hop's LOCAL [Ts, Ts] block through the
+    Pallas flash kernel (`ops/flash.py`) instead of materializing plain
+    score blocks in HBM — composing the two O(T)-memory techniques so
+    per-shard Ts can grow past the point where a [Ts, Ts] f32 block
+    itself is the HBM wall (at Ts=8k one block is 256 MB per (B, H)).
+    Both forward and backward are flash-tiled; see `_ring_flash`.
     """
+    if use_flash:
+        if scale is None:
+            scale = 1.0 / (q.shape[-1] ** 0.5)
+        return _ring_flash(q, k, v, axis_name, causal, scale)
     p = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, ts, h, d = q.shape
@@ -129,30 +143,200 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# ring + flash composition
+#
+# The insight that makes the two compose: the ring is ONE flash
+# computation whose K/V blocks stream over the interconnect instead of
+# over a kernel grid axis. Per hop the LOCAL block runs the flash
+# forward (returning the block's logsumexp), and hop outputs merge by
+# the standard rescale  out = sum_h out_h * exp(lse_h - LSE),
+# LSE = logaddexp_h lse_h.  For the backward, rebuilding the softmax
+# from the GLOBAL logsumexp turns the per-hop flash backward into the
+# exact global gradient contribution of that hop's block:
+# p_h = exp(s_h - LSE) is the global softmax restricted to the block, so
+# ds_h = p_h * (dO V_h^T - rowsum(dO * O_global)) — precisely what
+# `ops/flash.py`'s backward kernels compute when handed O_global and
+# LSE_global in place of the local residuals. dK/dV contributions travel
+# WITH their block around the ring and arrive home after p hops.
+# ---------------------------------------------------------------------------
+
+
+def _hop_flash_fwd(q, k_blk, v_blk, causal, scale):
+    """One hop's local flash forward: (out [B,Ts,H,D], lse [B,H,Ts])."""
+    from ..ops.flash import _flash_fwd_impl, _tiles
+
+    b, ts, h, d = q.shape
+    if _tiles(ts, causal, None, None) is None:
+        # shapes don't tile: plain math, same contract (checked up
+        # front — _flash_fwd_impl's internal fallback would compute the
+        # whole attention only to come back without the lse)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k_blk.astype(jnp.float32)) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((ts, ts), bool))
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        lse_h = jax.nn.logsumexp(s, axis=-1)                 # [B,H,Ts]
+        out = jnp.einsum("bhqk,bkhd->bqhd", jnp.exp(s - lse_h[..., None]),
+                         v_blk.astype(jnp.float32)).astype(q.dtype)
+        return out, lse_h
+    out, lse = _flash_fwd_impl(q, k_blk, v_blk, causal, scale, None,
+                               None, None, save_lse=True)
+    return out, lse.reshape(b, h, ts)
+
+
+def _hop_flash_bwd(q, k_blk, v_blk, out_g, lse_g, g, causal, scale):
+    """One hop's gradient contribution against the GLOBAL (out, lse).
+
+    Returns (dq_h, dk_blk, dv_blk), all f32. `out_g` [B,Ts,H,D] and
+    `lse_g` [B,H,Ts] are the fully-merged ring results; passing them in
+    place of the local residuals makes the flash backward kernels
+    reconstruct the global softmax restricted to this block (see the
+    module comment above).
+    """
+    from ..ops.flash import _flash_bwd_impl, _tiles
+
+    b, ts, h, d = q.shape
+    f32 = jnp.float32
+    if _tiles(ts, causal, None, None) is not None:
+        dq, dk, dv = _flash_bwd_impl(
+            q, k_blk, v_blk, out_g, lse_g.reshape(b * h, ts), g, causal,
+            scale, None, None, None)
+        return dq.astype(f32), dk.astype(f32), dv.astype(f32)
+    # plain-math path, identical contract
+    qf, kf, vf = (x.astype(f32) for x in (q, k_blk, v_blk))
+    gf, of = g.astype(f32), out_g.astype(f32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((ts, ts), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jnp.exp(s - lse_g[..., None])                       # global probs
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vf)
+    delta = jnp.sum(gf * of, axis=-1).transpose(0, 2, 1)    # [B,H,Ts]
+    ds = p * (dp - delta[..., None])
+    dq = scale * jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+    dk = scale * jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale):
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    perm = [(r, (r + 1) % p) for r in range(p)]
+    f32 = jnp.float32
+
+    # hop 0: the diagonal block, local causal mask applies
+    o0, l0 = _hop_flash_fwd(q, k, v, causal, scale)
+    out_acc, lse_acc = o0.astype(f32), l0
+
+    def body(i, carry):
+        out_acc, lse_acc, k_blk, v_blk = carry
+        o_h, l_h = _hop_flash_fwd(q, k_blk, v_blk, False, scale)
+        src = (rank - i) % p
+        active = (src < rank) if causal else True
+        lse_new = jnp.logaddexp(lse_acc, l_h)
+        w_old = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
+        w_new = jnp.exp(l_h - lse_new).transpose(0, 2, 1)[..., None]
+        out_new = out_acc * w_old + o_h.astype(f32) * w_new
+        out_acc = jnp.where(active, out_new, out_acc)
+        lse_acc = jnp.where(active, lse_new, lse_acc)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return out_acc, lse_acc, k_blk, v_blk
+
+    if p > 1:
+        k1 = lax.ppermute(k, axis_name, perm)
+        v1 = lax.ppermute(v, axis_name, perm)
+        out_acc, lse_acc, _, _ = lax.fori_loop(
+            1, p, body, (out_acc, lse_acc, k1, v1))
+    return out_acc.astype(q.dtype), lse_acc
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, res, g):
+    q, k, v, out, lse = res
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    perm = [(r, (r + 1) % p) for r in range(p)]
+    f32 = jnp.float32
+
+    # hop 0: local block, causal mask applies
+    dq0, dk0, dv0 = _hop_flash_bwd(q, k, v, out, lse, g, causal, scale)
+    dq_acc = dq0
+
+    def body(i, carry):
+        dq_acc, dk_blk, dv_blk, k_blk, v_blk = carry
+        dq_h, dk_h, dv_h = _hop_flash_bwd(q, k_blk, v_blk, out, lse, g,
+                                          False, scale)
+        src = (rank - i) % p
+        active = (src < rank) if causal else True
+        dq_acc = jnp.where(active, dq_acc + dq_h, dq_acc)
+        dk_blk = jnp.where(active, dk_blk + dk_h, dk_blk)
+        dv_blk = jnp.where(active, dv_blk + dv_h, dv_blk)
+        # grads travel WITH their K/V block; after p total rotations
+        # both are back at the block's home rank
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = lax.ppermute(dv_blk, axis_name, perm)
+        return dq_acc, dk_blk, dv_blk, k_blk, v_blk
+
+    dk_acc, dv_acc = dk0, dv0
+    if p > 1:
+        k1 = lax.ppermute(k, axis_name, perm)
+        v1 = lax.ppermute(v, axis_name, perm)
+        dk1 = lax.ppermute(dk0, axis_name, perm)
+        dv1 = lax.ppermute(dv0, axis_name, perm)
+        dq_acc, dk_acc, dv_acc, _, _ = lax.fori_loop(
+            1, p, body, (dq_acc, dk1, dv1, k1, v1))
+        # p - 1 in-loop rotations + the pre-loop one = p: home again
+    return (dq_acc.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def seq_to_heads(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     """[B, Ts, H, D] sequence-sharded -> [B, Ts*P, H/P, D] head-sharded
-    (DeepSpeed-Ulysses forward all-to-all). Requires H % axis_size == 0."""
-    p = lax.axis_size(axis_name)
-    b, ts, h, d = x.shape
-    x = x.reshape(b, ts, p, h // p, d)
-    # split the head axis across devices, concatenate the sequence axis
-    x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                       tiled=False)
-    return x.reshape(b, ts * p, h // p, d)
+    (DeepSpeed-Ulysses forward all-to-all). Requires H % axis_size == 0.
+
+    Uses `tiled=True` so no reshape surrounds the collective: device r
+    keeps head chunk r and receives every rank's sequence block,
+    concatenated rank-major along the sequence axis — which IS global
+    sequence order for rank-major shards. The reshape-wrapped
+    `tiled=False` formulation is equivalent in the forward but its
+    TRANSPOSE miscompiles under `shard_map(check_vma=False)` (upstream
+    JAX 0.9.0: the backward's reshape is emitted with the pre-collective
+    element count; see docs/long_context.md "Upstream all_to_all grad
+    bug" for the 30-line no-kungfu repro). tiled=True needs no reshapes,
+    so gradients flow — this is what makes Ulysses TRAINING work.
+    """
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
 
 
 def heads_to_seq(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
-    """Inverse of seq_to_heads: [B, T, H/P, D] -> [B, T/P, H, D]."""
-    p = lax.axis_size(axis_name)
-    b, t, hp, d = x.shape
-    x = x.reshape(b, p, t // p, hp, d)
-    # the source-rank axis must land BEFORE the local-heads axis: source s
-    # held heads [s*hp, (s+1)*hp), so flattening (P, hp) source-major
-    # restores h = s*hp + j — concat_axis=3 would interleave heads
-    # whenever hp > 1
-    x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                       tiled=False)
-    return x.reshape(b, t // p, hp * p, d)
+    """Inverse of seq_to_heads: [B, T, H/P, D] -> [B, T/P, H, D].
+
+    tiled=True concatenates received blocks rank-major along the head
+    axis: source s held heads [s*hp, (s+1)*hp), so h = s*hp + j — the
+    original head order (an interleaved layout would need concat inside
+    a reshape, exactly the pattern whose gradient miscompiles).
+    """
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
 
 
 def ulysses_attention(

@@ -210,21 +210,16 @@ def test_explicit_nondividing_blocks_fall_back():
                                rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.xfail(
-    reason="upstream JAX bug: differentiating through all_to_all "
-           "(tiled=False) around a custom_vjp inside "
-           "shard_map(check_vma=False) miscompiles (MLIR reshape "
-           "element-count mismatch). The PLAIN ulysses path under "
-           "check_vma=True hits the same verifier error, so this is "
-           "not specific to the pallas kernel. Long-context TRAINING "
-           "uses GPTConfig(attention='flash') (no shard_map; fastest "
-           "measured) or ring attention; ulysses+flash is "
-           "forward/inference-only until the fix.",
-    raises=ValueError, strict=True)
 def test_ulysses_flash_grads_match_plain():
     """The long-context TRAINING composition: gradients flow through
     the flash kernel inside the Ulysses shard_map and match the plain
-    local-mixer run."""
+    local-mixer run.
+
+    Was strict-xfailed in round 2: the reshape-wrapped
+    `all_to_all(tiled=False)` formulation miscompiles the BACKWARD under
+    shard_map(check_vma=False) (upstream JAX 0.9.0 — minimal repro in
+    docs/long_context.md). seq_to_heads/heads_to_seq now use tiled=True,
+    which needs no reshapes around the collective, so grads flow."""
     from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 

@@ -171,3 +171,49 @@ def test_ring_attention_grads_flow():
     g_full = jax.grad(loss_full)(q)
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_full),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full(causal):
+    """Ring + flash composition (VERDICT r2 item 7): each hop's local
+    block runs the Pallas flash kernel; output must match plain full
+    attention on the gathered sequence."""
+    q, k, v = make_qkv(seed=5)
+    with jax.default_matmul_precision("highest"):
+        out = run_sharded(
+            lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal,
+                                           use_flash=True),
+            q, k, v)
+        ref = _local_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_grads_match_full(causal):
+    """All three gradients through the hand-written ring+flash VJP
+    (dK/dV contributions travel the ring back to their block's owner)
+    must match autodiff through plain full attention."""
+    q, k, v = make_qkv(seed=6)
+    g = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+    mesh = seq_mesh()
+    mapped = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal,
+                                       use_flash=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False)
+
+    def loss_flash(q, k, v):
+        return jnp.vdot(mapped(q, k, v), g)
+
+    def loss_full(q, k, v):
+        return jnp.vdot(_local_attention(q, k, v, causal=causal), g)
+
+    with jax.default_matmul_precision("highest"):
+        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        gp = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), gf, gp):
+        scale = float(jnp.max(jnp.abs(b))) or 1.0
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=2e-4 * scale,
+                                   err_msg=name)
