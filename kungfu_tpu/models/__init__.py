@@ -13,7 +13,8 @@ stay in exact parity with the architectures.
 from .bert import BertConfig, BertEncoder
 from .fake_models import fake_model_catalog, model_param_sizes
 from .gpt import (GPTConfig, GPTLM, gpt_generate, gpt_loss,
-                  gpt_pipeline_forward, stack_gpt_blocks)
+                  gpt_loss_with_aux, gpt_pipeline_forward,
+                  stack_gpt_blocks)
 from .inception import InceptionV3
 from .mlp import MLP, SLP
 from .resnet import ResNet, ResNet18, ResNet50, ResNet101
@@ -34,6 +35,7 @@ __all__ = [
     "GPTLM",
     "gpt_generate",
     "gpt_loss",
+    "gpt_loss_with_aux",
     "gpt_pipeline_forward",
     "stack_gpt_blocks",
     "fake_model_catalog",

@@ -52,12 +52,19 @@ class GPTConfig:
     # dispatch/combine einsums to all-to-alls — no shard_map needed.
     num_experts: int = 0
     moe_capacity_factor: float = 1.25
+    # Router training signals (sown into the "losses" collection by
+    # MoEMLP; `gpt_loss_with_aux` folds them into the objective). The
+    # Switch load-balance loss keeps expert load near-uniform — without
+    # it a top-1 router collapses onto few experts and the capacity drop
+    # eats the tokens; the z-loss keeps router logits small. Defaults
+    # follow Switch/ST-MoE (1e-2, 1e-3).
+    moe_aux_coef: float = 1e-2
+    moe_z_coef: float = 1e-3
     # attention="ulysses" only: run the per-head-subset local mixer
-    # through the Pallas flash kernel. FORWARD/INFERENCE only for now:
-    # an upstream JAX bug miscompiles grads through all_to_all around a
-    # custom_vjp inside shard_map (tests/test_flash.py xfail). For
-    # long-context TRAINING use attention="flash" (no shard_map;
-    # fastest measured) or "ring".
+    # through the Pallas flash kernel. Trains end-to-end: the Ulysses
+    # all-to-alls use tiled=True, sidestepping the upstream JAX grad
+    # miscompile of the reshape-wrapped tiled=False form (repro +
+    # details in docs/long_context.md).
     use_flash: bool = False
     # routing group size (GShard/Switch): tokens route within fixed-size
     # groups so dispatch/combine tensors stay LINEAR in total tokens
@@ -222,9 +229,19 @@ class MoEMLP(nn.Module):
         n_groups = (b * t) // group
         tokens = x.reshape(n_groups, group, h)
         capacity = moe_capacity(group, c.moe_capacity_factor, e)
-        dispatch, combine = jax.vmap(
-            lambda tg: dispatch_tensors(tg, router, e, capacity))(
+        dispatch, combine, aux = jax.vmap(
+            lambda tg: dispatch_tensors(tg, router, e, capacity,
+                                        return_aux=True))(
             tokens)                                  # [G, E, C, g] f32
+        # router training signals, averaged over routing groups; the
+        # "losses" collection is folded into the objective by
+        # `gpt_loss_with_aux` — without the load-balance term a top-1
+        # router collapses (see parallel/expert.py:dispatch_tensors)
+        self.sow("losses", "moe_load_balance", aux["load_balance"].mean())
+        self.sow("losses", "moe_z_loss", aux["z_loss"].mean())
+        self.sow("losses", "moe_dropped_frac", aux["dropped_frac"].mean())
+        self.sow("losses", "moe_expert_load",
+                 aux["expert_load"].mean(axis=0))  # [E]
         # gather in the param dtype (dispatch entries are exact 0/1);
         # gate-weighted combine stays f32 like parallel.expert.moe_mlp
         slots = jnp.einsum("gect,gth->gech", dispatch.astype(c.dtype),
@@ -334,6 +351,42 @@ def gpt_loss(logits, token_ids):
         logits[:, :-1].astype(jnp.float32), token_ids[:, 1:]).mean()
 
 
+def gpt_loss_with_aux(model: GPTLM, params, token_ids):
+    """(total_loss, metrics): cross entropy + the MoE router losses.
+
+    Runs the model with the "losses" collection mutable, averages each
+    sown signal over layers, and returns
+    ``ce + moe_aux_coef * load_balance + moe_z_coef * z_loss`` plus a
+    metrics dict (ce / load_balance / z_loss / dropped_frac). For dense
+    configs (num_experts=0) this reduces to `gpt_loss`. Use this — not
+    bare `gpt_loss` — when training an MoE config, or the router
+    collapses onto few experts.
+    """
+    c = model.config
+    logits, mutated = model.apply({"params": params}, token_ids,
+                                  mutable=["losses"])
+    ce = gpt_loss(logits, token_ids)
+    metrics = {"ce": ce}
+    total = ce
+    if c.num_experts:
+        from flax import traverse_util
+
+        flat = traverse_util.flatten_dict(mutated.get("losses", {}))
+
+        def layer_mean(name):
+            vals = [v for k, vs in flat.items() if k[-1] == name
+                    for v in vs]  # sow stores a tuple per call site
+            return jnp.mean(jnp.stack(vals), axis=0)
+
+        metrics["load_balance"] = layer_mean("moe_load_balance")
+        metrics["z_loss"] = layer_mean("moe_z_loss")
+        metrics["dropped_frac"] = layer_mean("moe_dropped_frac")
+        metrics["expert_load"] = layer_mean("moe_expert_load")  # [E]
+        total = (ce + c.moe_aux_coef * metrics["load_balance"]
+                 + c.moe_z_coef * metrics["z_loss"])
+    return total, metrics
+
+
 def gpt_generate(model: GPTLM, params, prompt, num_steps: int,
                  rng=None, temperature: float = 0.0):
     """Autoregressive generation with a KV cache.
@@ -346,6 +399,8 @@ def gpt_generate(model: GPTLM, params, prompt, num_steps: int,
     """
     c = model.config
     b, t0 = prompt.shape
+    if num_steps <= 0:
+        raise ValueError(f"num_steps must be >= 1, got {num_steps}")
     if t0 + num_steps > c.max_position:
         raise ValueError(
             f"prompt {t0} + steps {num_steps} exceeds max_position "
@@ -461,3 +516,67 @@ def gpt_pipeline_forward(cfg: GPTConfig, outer, stage_blocks, tokens,
         {"params": outer["LayerNorm_0"]}, x)
     return nn.Dense(cfg.vocab_size, dtype=jnp.float32).apply(
         {"params": outer["lm_head"]}, x)
+
+
+def gpt_pipeline_train_step(cfg: GPTConfig, outer, stage_blocks, tokens,
+                            axis_name: str, num_microbatches: int):
+    """1F1B pipelined loss + gradients for GPT; runs INSIDE `shard_map`
+    over `axis_name`.
+
+    The full training composition (`parallel.pipeline.
+    pipeline_train_step_1f1b`): embedding is stage 0's entry edge, the
+    final LayerNorm + lm_head + cross entropy are stage P-1's exit edge,
+    and the Block trunk streams microbatches with one forward and one
+    backward in flight per device after warmup. Only the int32 `tokens`
+    are replicated across stages; activations live on exactly one stage
+    each and in-flight storage is 2P microbatches regardless of M.
+
+    - `outer` / `stage_blocks`: from `stack_gpt_blocks`; pass
+      `stage_blocks` with in_specs P('pipe') (leading singleton stage
+      axis per device).
+    - `tokens`: [B, T], B % num_microbatches == 0, in_specs P().
+
+    Returns `(loss, g_outer, g_stage)` for out_specs
+    `(P(), P(), P('pipe'))`: scalar mean loss, replicated edge grads,
+    and the stage-stacked Block grads matching `stage_blocks`' layout —
+    feed them straight to the same optimizer layout as the params.
+    """
+    from ..parallel.pipeline import pipeline_train_step_1f1b
+
+    b, t = tokens.shape
+    m = num_microbatches
+    if b % m:
+        raise ValueError(f"batch {b} % microbatches {m} != 0")
+    if t > cfg.max_position:
+        raise ValueError(f"sequence {t} exceeds max_position "
+                         f"{cfg.max_position}")
+    micro = tokens.reshape(m, b // m, t)
+    embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype)
+    pos_embed = nn.Embed(cfg.max_position, cfg.hidden_size,
+                         dtype=cfg.dtype)
+    ln = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32)
+    head = nn.Dense(cfg.vocab_size, dtype=jnp.float32)
+
+    def enter_fn(op, mb_tokens):
+        x = embed.apply({"params": op["wte"]}, mb_tokens)
+        return x + pos_embed.apply({"params": op["wpe"]},
+                                   jnp.arange(t)[None, :])
+
+    def stage_fn(stacked, h):
+        def body(h, layer_params):
+            return Block(cfg).apply({"params": layer_params}, h), None
+
+        h, _ = lax.scan(body, h, stacked)
+        return h
+
+    def exit_fn(op, h, mb_tokens):
+        x = ln.apply({"params": op["LayerNorm_0"]}, h)
+        logits = head.apply({"params": op["lm_head"]}, x)
+        return gpt_loss(logits, mb_tokens)
+
+    loss, g_outer, g_stage = pipeline_train_step_1f1b(
+        stage_fn, enter_fn, exit_fn,
+        jax.tree_util.tree_map(lambda l: l[0], stage_blocks),
+        outer, micro, axis_name)
+    return loss, g_outer, jax.tree_util.tree_map(
+        lambda g: g[None], g_stage)

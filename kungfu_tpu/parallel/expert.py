@@ -62,12 +62,28 @@ def moe_capacity(tokens: int, capacity_factor: float,
     return max(1, -(-int(tokens * capacity_factor) // num_experts))
 
 
-def dispatch_tensors(x, router, num_experts: int, capacity: int):
+def dispatch_tensors(x, router, num_experts: int, capacity: int,
+                     return_aux: bool = False):
     """Switch top-1 routing on local tokens x [T, H].
 
     Returns (dispatch [E, C, T] one-hot-ish, combine [E, C, T] prob-
     weighted) such that einsum over T gathers tokens into expert slots
     and the transpose scatters results back.
+
+    With `return_aux`, additionally returns the router training signals
+    (all scalar f32) — without them a top-1 router collapses onto few
+    experts and the capacity drop silently eats the rest of the tokens:
+
+    - ``load_balance``: the Switch auxiliary loss (Fedus et al. 2021
+      eq. 4), E * sum_e f_e * P_e where f_e is the fraction of tokens
+      argmax-routed to expert e and P_e the mean router probability for
+      e. Equals 1.0 under perfectly uniform routing; minimizing it
+      pushes the dispatch toward uniform (it is differentiable through
+      P_e).
+    - ``z_loss``: mean(logsumexp(logits)^2) (ST-MoE, Zoph et al. 2022),
+      keeping router logits small and routing gradients well-scaled.
+    - ``dropped_frac``: fraction of tokens that lost their capacity slot
+      (observability; not differentiable, detached).
     """
     logits = (x.astype(jnp.float32) @ router.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)          # [T, E]
@@ -84,7 +100,22 @@ def dispatch_tensors(x, router, num_experts: int, capacity: int):
     gate = jnp.where(keep.any(-1), (probs * onehot).sum(-1), 0.0)  # [T]
     dispatch = jnp.einsum("te,tc->ect", onehot * keep, slot)
     combine = dispatch * gate[None, None, :]
-    return dispatch, combine
+    if not return_aux:
+        return dispatch, combine
+    frac_routed = onehot.mean(axis=0)                # f_e, [E]
+    mean_prob = probs.mean(axis=0)                   # P_e, [E]
+    aux = {
+        "load_balance": num_experts * jnp.sum(
+            lax.stop_gradient(frac_routed) * mean_prob),
+        "z_loss": jnp.mean(
+            jax.nn.logsumexp(logits, axis=-1) ** 2),
+        "dropped_frac": lax.stop_gradient(
+            1.0 - keep.any(-1).astype(jnp.float32).mean()),
+        # per-expert dispatch fraction [E] (detached): lets callers
+        # monitor load entropy over training
+        "expert_load": lax.stop_gradient(frac_routed),
+    }
+    return dispatch, combine, aux
 
 
 def moe_mlp(
@@ -92,12 +123,19 @@ def moe_mlp(
     params: MoEParams,
     axis_name: str,
     capacity_factor: float = 1.25,
-) -> jnp.ndarray:
+    return_aux: bool = False,
+):
     """Top-1 MoE feed-forward for the local token shard x [T, H].
 
     Experts are sharded over `axis_name` (device d holds experts
     [d*localE, (d+1)*localE)); two all_to_alls move token slots to their
     expert's device and back.
+
+    With `return_aux`, returns (y, aux) where aux holds the Switch
+    load-balance loss, router z-loss, and dropped-token fraction
+    pmean'd over `axis_name` — add ``coef_lb * aux["load_balance"] +
+    coef_z * aux["z_loss"]`` to the training loss or the router
+    collapses (see `dispatch_tensors`).
     """
     p = lax.axis_size(axis_name)
     t, h = x.shape
@@ -105,8 +143,13 @@ def moe_mlp(
     num_experts = local_e * p
     capacity = moe_capacity(t, capacity_factor, num_experts)
 
-    dispatch, combine = dispatch_tensors(x, params.router, num_experts,
-                                          capacity)
+    routed = dispatch_tensors(x, params.router, num_experts, capacity,
+                              return_aux=return_aux)
+    if return_aux:
+        dispatch, combine, aux = routed
+        aux = {k: lax.pmean(v, axis_name) for k, v in aux.items()}
+    else:
+        dispatch, combine = routed
     # gather local tokens into expert slots: [E, C, H]
     slots = jnp.einsum("ect,th->ech", dispatch, x.astype(jnp.float32))
     # ship each expert's slots to its owner device:
@@ -127,4 +170,5 @@ def moe_mlp(
                          tiled=True)
     out = out.reshape(num_experts, capacity, h)
     y = jnp.einsum("ect,ech->th", combine, out)
-    return y.astype(x.dtype)
+    y = y.astype(x.dtype)
+    return (y, aux) if return_aux else y

@@ -88,3 +88,154 @@ def stack_stage_params(per_stage_params):
     axis, ready to shard with PartitionSpec('pipe', ...)."""
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def pipeline_train_step_1f1b(
+    stage_fn: Callable,
+    enter_fn: Callable,
+    exit_fn: Callable,
+    stage_params,
+    outer_params,
+    inputs: jnp.ndarray,
+    axis_name: str,
+):
+    """One-forward-one-backward pipelined TRAINING step.
+
+    The full pipeline schedule, not just a forward demo: edge stages are
+    non-shape-preserving (`enter_fn` turns a raw microbatch into the
+    [mb, ...] activation on stage 0; `exit_fn` turns the last stage's
+    activation into a scalar loss), and after a P-tick warmup every tick
+    runs ONE forward and ONE backward microbatch per device (1F1B,
+    PipeDream-flush ordering) — so in-flight activation storage is a
+    ring buffer of 2P stage inputs, INDEPENDENT of the number of
+    microbatches M, where GPipe-via-autodiff would save all M + P - 1
+    tick residuals. Backward recomputes each stage's forward from the
+    saved stage input (the same remat trade `ops/flash.py` and
+    `ring_attention` make).
+
+    - `stage_fn(stage_params, h) -> h`: this device's (shape-preserving)
+      trunk stage.
+    - `enter_fn(outer_params, micro) -> h`: stage 0 only — e.g. token
+      embedding. `micro` = `inputs[i]`.
+    - `exit_fn(outer_params, h, micro) -> scalar`: stage P-1 only — e.g.
+      head + mean cross entropy; `micro` doubles as the target source.
+    - `inputs`: [M, ...] raw microbatches, replicated over the axis.
+      Only raw INPUTS (e.g. int tokens) are replicated — activations
+      never are; each lives on exactly one stage per tick.
+
+    Runs INSIDE `shard_map` over `axis_name`. Returns
+    `(loss, g_outer, g_stage)`: mean loss over microbatches, gradients
+    for the (shared) edge params — psum'd so they are replicated — and
+    gradients for THIS device's stage params. Suggested out_specs:
+    `(P(), P(), P('pipe'))` with a leading axis added to g_stage by the
+    caller (see `models/gpt.py:gpt_pipeline_train_step`).
+
+    Schedule (microbatch i, stage r, P stages, tick t):
+      forward at t = i + r; backward at t = i + 2P - r - 1.
+    A stage input saved at forward tick is read 2(P - r) - 1 ticks
+    later, always before the slot is reused (distance 2P), so the ring
+    buffer needs exactly 2P slots.
+    """
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    m = inputs.shape[0]
+    fwd_perm = [(r, (r + 1) % p) for r in range(p)]
+    bwd_perm = [(r, (r - 1) % p) for r in range(p)]
+
+    # trace one enter to learn the activation shape/dtype
+    h_shape = jax.eval_shape(enter_fn, outer_params, inputs[0])
+    zeros_h = jnp.zeros(h_shape.shape, h_shape.dtype)
+    depth = 2 * p
+    buf0 = jnp.zeros((depth,) + h_shape.shape, h_shape.dtype)
+
+    zeros_like = lambda t: jax.tree_util.tree_map(jnp.zeros_like, t)
+    g_stage0 = zeros_like(stage_params)
+    g_outer0 = zeros_like(outer_params)
+
+    def masked_add(acc, new, cond):
+        return jax.tree_util.tree_map(
+            lambda a, n: a + jnp.where(cond, n, jnp.zeros_like(n)),
+            acc, new)
+
+    def tick(t, state):
+        fwd_c, bwd_c, buf, g_stage, g_outer, loss_acc = state
+
+        # ---- forward: microbatch i_f enters this stage ----
+        i_f = t - rank
+        active_f = (i_f >= 0) & (i_f < m)
+        if_c = jnp.clip(i_f, 0, m - 1)
+        feed = lax.dynamic_index_in_dim(inputs, if_c, 0, keepdims=False)
+        # lax.cond on the (dynamic) rank compiles to a per-device HLO
+        # conditional: the embedding runs ONLY on stage 0 instead of on
+        # every rank with the result masked away
+        h_in = lax.cond(rank == 0,
+                        lambda: enter_fn(outer_params, feed),
+                        lambda: fwd_c)
+        slot_w = (if_c + rank) % depth
+        buf_new = lax.dynamic_update_index_in_dim(buf, h_in, slot_w, 0)
+        buf = jnp.where(active_f, buf_new, buf)
+        h_out = stage_fn(stage_params, h_in)
+
+        # ---- backward: microbatch i_b retires from this stage ----
+        i_b = t - (2 * p - rank - 1)
+        active_b = (i_b >= 0) & (i_b < m)
+        ib_c = jnp.clip(i_b, 0, m - 1)
+        h_saved = lax.dynamic_index_in_dim(buf, (ib_c + rank) % depth, 0,
+                                           keepdims=False)
+        micro_b = lax.dynamic_index_in_dim(inputs, ib_c, 0,
+                                           keepdims=False)
+
+        # ONE trunk VJP per tick; the cheap edge VJPs chain off it and
+        # run under lax.cond so a vocab-sized head never executes on
+        # middle stages. The trunk forward recompute doubles as the
+        # exit edge's input, the trunk cotangent feeds the enter edge —
+        # so a rank that is both first and last (p == 1) gets BOTH edge
+        # gradients.
+        is_last = rank == p - 1
+        is_first = rank == 0
+        h_out_b, vjp_stage = jax.vjp(
+            lambda sp, h: stage_fn(sp, h), stage_params, h_saved)
+
+        def exit_edge():
+            loss_i, vjp_exit = jax.vjp(
+                lambda op, h: exit_fn(op, h, micro_b), outer_params,
+                h_out_b)
+            go, gh = vjp_exit(jnp.ones((), loss_i.dtype))
+            return loss_i.astype(jnp.float32), go, gh
+
+        def exit_skip():
+            return (jnp.zeros((), jnp.float32), zeros_like(outer_params),
+                    jnp.zeros_like(h_out_b))
+
+        loss_i, go_exit, gh_exit = lax.cond(is_last, exit_edge, exit_skip)
+        g_out = jnp.where(is_last, gh_exit, bwd_c)
+        gs, gh = vjp_stage(g_out)
+
+        go_enter = lax.cond(
+            is_first,
+            lambda: jax.vjp(lambda op: enter_fn(op, micro_b),
+                            outer_params)[1](gh)[0],
+            lambda: zeros_like(outer_params))
+
+        go = jax.tree_util.tree_map(lambda a, b: a + b, go_exit, go_enter)
+        g_stage = masked_add(g_stage, gs, active_b)
+        g_outer = masked_add(g_outer, go, active_b)
+        loss_acc = loss_acc + jnp.where(active_b, loss_i, 0.0)
+
+        fwd_c = lax.ppermute(h_out, axis_name, fwd_perm)
+        bwd_c = lax.ppermute(gh, axis_name, bwd_perm)
+        return fwd_c, bwd_c, buf, g_stage, g_outer, loss_acc
+
+    state0 = (zeros_h, zeros_h, buf0, g_stage0, g_outer0,
+              jnp.zeros((), jnp.float32))
+    _, _, _, g_stage, g_outer, loss_sum = lax.fori_loop(
+        0, m + 2 * p - 1, tick, state0)
+
+    # per-microbatch means -> batch mean; edge grads live on one stage
+    # each, psum replicates them (and scales: each mb's loss contributes
+    # 1/M to the total)
+    loss = lax.psum(loss_sum, axis_name) / m
+    g_outer = jax.tree_util.tree_map(
+        lambda g: lax.psum(g, axis_name) / m, g_outer)
+    g_stage = jax.tree_util.tree_map(lambda g: g / m, g_stage)
+    return loss, g_outer, g_stage

@@ -113,6 +113,7 @@ def build_gspmd_train_step(
     loss_fn: Callable,
     tx: optax.GradientTransformation,
     donate: bool = True,
+    has_aux: bool = False,
 ):
     """Compile a train step for the GSPMD (annotation-sharded) layout.
 
@@ -124,12 +125,21 @@ def build_gspmd_train_step(
     `step(params, opt_state, batch) -> (params, opt_state, loss)` with
     params+opt donated (without donation XLA double-buffers the full
     f32 state — ~4.2 GB extra for GPT-2-medium + adamw).
+
+    With `has_aux`, `loss_fn(params, batch) -> (scalar, metrics)` (e.g.
+    `gpt_loss_with_aux` for MoE router losses) and the step returns
+    `(params, opt_state, loss, metrics)`.
     """
 
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        out, grads = jax.value_and_grad(loss_fn, has_aux=has_aux)(
+            params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        params = optax.apply_updates(params, updates)
+        if has_aux:
+            loss, metrics = out
+            return params, opt_state, loss, metrics
+        return params, opt_state, out
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 

@@ -251,6 +251,46 @@ class TestMoE:
             first = float(loss) if first is None else first
         assert float(loss) < first / 3, (first, float(loss))
 
+    def test_moe_router_stays_balanced_over_training(self):
+        """With the Switch load-balance + z losses in the objective
+        (`gpt_loss_with_aux`), ~100 training steps keep the expert-load
+        distribution near uniform entropy and the dropped-token fraction
+        bounded — the signals that separate a trainable MoE from a
+        router that collapses onto few experts (reference has no MoE;
+        VERDICT r2 item 3)."""
+        from kungfu_tpu.models import gpt_loss_with_aux
+        from kungfu_tpu.parallel import build_gspmd_train_step
+
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=4, intermediate_size=64,
+                        max_position=32, dtype=jnp.float32,
+                        num_experts=4, moe_capacity_factor=1.25)
+        model = GPTLM(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (16, 32), 0,
+                                    cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+        tx = optax.adam(1e-2)
+        opt = tx.init(params)
+        step = build_gspmd_train_step(
+            lambda p, t: gpt_loss_with_aux(model, p, t), tx,
+            has_aux=True)
+
+        first = None
+        for _ in range(100):
+            params, opt, loss, metrics = step(params, opt, tokens)
+            first = float(loss) if first is None else first
+        assert float(loss) < first, (first, float(loss))
+
+        load = np.asarray(metrics["expert_load"], np.float64)
+        load = load / load.sum()
+        entropy = -(load * np.log(load + 1e-9)).sum()
+        uniform = np.log(cfg.num_experts)
+        assert entropy > 0.85 * uniform, (
+            f"expert load collapsed: entropy {entropy:.3f} vs uniform "
+            f"{uniform:.3f}, load {load}")
+        assert float(metrics["dropped_frac"]) < 0.25, (
+            f"dropped fraction {float(metrics['dropped_frac']):.3f}")
+
     def test_moe_bf16_io(self):
         """bf16 params/activations: output bf16 and finite; gates (the
         combine path) stay f32 so probabilities aren't quantized."""
@@ -369,6 +409,80 @@ class TestPipelineParallel:
         params = model.init(jax.random.PRNGKey(1), tokens)["params"]
         with pytest.raises(ValueError, match="divide"):
             stack_gpt_blocks(params, 3)
+
+    def test_1f1b_single_stage_keeps_edge_grads(self):
+        """p=1 (one device is both first AND last stage) must still
+        produce nonzero embedding gradients — the edge-VJP chaining
+        regression where is_last shadowed is_first."""
+        from kungfu_tpu.models import stack_gpt_blocks
+        from kungfu_tpu.models.gpt import gpt_pipeline_train_step
+
+        model = GPTLM(self.CFG_PP)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0,
+                                    self.CFG_PP.vocab_size)
+        params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+        outer, stacked = stack_gpt_blocks(params, 1)
+        mesh = Mesh(np.array(jax.devices()[:1]), ("pipe",))
+        mapped = shard_map(
+            lambda o, s, t: gpt_pipeline_train_step(
+                self.CFG_PP, o, s, t, "pipe", num_microbatches=2),
+            mesh=mesh, in_specs=(P(), P("pipe"), P()),
+            out_specs=(P(), P(), P("pipe")), check_vma=False)
+        loss, g_outer, _ = jax.jit(mapped)(outer, stacked, tokens)
+        assert np.isfinite(float(loss))
+        for name in ("wte", "wpe", "LayerNorm_0", "lm_head"):
+            gnorm = sum(float(jnp.abs(l).sum()) for l in
+                        jax.tree_util.tree_leaves(g_outer[name]))
+            assert gnorm > 0, f"{name} gradient is zero at p=1"
+
+    def test_1f1b_training_step_matches_single_device(self):
+        """The REAL pipeline training path (VERDICT r2 item 6): 1F1B
+        schedule with embedding/loss edge stages and hand-rolled
+        per-stage VJPs — pp=4 loss AND all gradients must equal the
+        single-device model's to tolerance."""
+        from kungfu_tpu.models import stack_gpt_blocks
+        from kungfu_tpu.models.gpt import gpt_pipeline_train_step
+
+        n_stages, batch, seq, micro = 4, 8, 16, 8
+        model = GPTLM(self.CFG_PP)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (batch, seq),
+                                    0, self.CFG_PP.vocab_size)
+        params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+        outer, stacked = stack_gpt_blocks(params, n_stages)
+        mesh = Mesh(np.array(jax.devices()[:n_stages]), ("pipe",))
+        mapped = shard_map(
+            lambda o, s, t: gpt_pipeline_train_step(
+                self.CFG_PP, o, s, t, "pipe", num_microbatches=micro),
+            mesh=mesh, in_specs=(P(), P("pipe"), P()),
+            out_specs=(P(), P(), P("pipe")), check_vma=False)
+
+        with jax.default_matmul_precision("highest"):
+            loss_pp, g_outer, g_stacked = jax.jit(mapped)(
+                outer, stacked, tokens)
+
+            def loss_ref_fn(p):
+                return gpt_loss(model.apply({"params": p}, tokens),
+                                tokens)
+
+            loss_ref, g_ref = jax.value_and_grad(loss_ref_fn)(params)
+
+        # the 1F1B loss averages per-microbatch means over equal-sized
+        # microbatches == the full-batch mean
+        np.testing.assert_allclose(float(loss_pp), float(loss_ref),
+                                   rtol=2e-5)
+        g_ref_outer, g_ref_stacked = stack_gpt_blocks(g_ref, n_stages)
+        for (ka, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(g_ref_outer)[0],
+                jax.tree_util.tree_flatten_with_path(g_outer)[0]):
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(b)), np.asarray(a),
+                rtol=1e-3, atol=1e-5, err_msg=f"outer {ka}")
+        for (ka, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(g_ref_stacked)[0],
+                jax.tree_util.tree_flatten_with_path(g_stacked)[0]):
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(b)), np.asarray(a),
+                rtol=1e-3, atol=1e-5, err_msg=f"stage {ka}")
 
 
 class TestGenerate:
