@@ -26,6 +26,12 @@ import numpy as np
 
 
 def worker(args) -> int:
+    # control-plane-only worker: never let a stray jnp call initialize
+    # an accelerator backend (JAX_PLATFORMS=cpu alone does not pin the
+    # backend on hosts whose PJRT plugin registers via sitecustomize)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     import kungfu_tpu
     from kungfu_tpu.elastic import ElasticCallback
 
@@ -40,6 +46,11 @@ def worker(args) -> int:
         out = p.all_reduce(np.ones(4, np.float32),
                            name=f"work:{p.version}:{elastic.state.step}")
         assert out[0] == p.size
+        if args.step_ms:
+            # emulate per-step compute: resizes then happen from steady
+            # state (runner's warm pool populated, imports finished)
+            # instead of milliseconds after cluster boot
+            time.sleep(args.step_ms / 1e3)
         old_size = p.size
         t0 = time.perf_counter()
         if elastic.after_step():
@@ -82,6 +93,7 @@ def launch(args) -> int:
             "--", sys.executable, "-m", "kungfu_tpu.benchmarks.adaptation",
             "--schedule", args.schedule, "--steps", str(args.steps),
             "--payload-mb", str(args.payload_mb), "--np", str(args.np),
+            "--step-ms", str(args.step_ms),
         ]
         return subprocess.call(cmd, env=env)
     finally:
@@ -99,6 +111,9 @@ def main(argv=None) -> int:
     ap.add_argument("--max-np", type=int, default=8, help="host slot count")
     ap.add_argument("--payload-mb", type=int, default=4,
                     help="joiner-broadcast payload size")
+    ap.add_argument("--step-ms", type=int, default=0,
+                    help="per-step sleep emulating compute (steady-state "
+                         "resizes vs boot-transient ones)")
     ap.add_argument("--port-range", default="27000-27999")
     ap.add_argument("--logdir", default=".kf-adaptation-logs")
     args = ap.parse_args(argv)

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 from ..ffi import NativePeer
 from ..peer import Stage
 from ..plan import PeerID, PeerList
-from .job import ChipPool, Proc, spawn_worker
+from .job import ChipPool, Proc, WarmPool, activate_warm, spawn_worker
 
 
 def _local_workers(workers: PeerList, host_ipv4: int) -> PeerList:
@@ -90,6 +90,11 @@ class Watcher:
         self.quiet = quiet
         self.keep = keep
         self.pool = ChipPool(slots)
+        self.slots = slots
+        # joiners activate from pre-warmed interpreters (imports already
+        # paid) so a resize costs one env write, not a python+jax boot —
+        # the bulk of round 2's ~6s resize latency (KF_PREWARM=0 opts out)
+        self.warm = WarmPool(prog, target=0, quiet=True, logdir=logdir)
         self.procs: Dict[PeerID, Proc] = {}
         self.expected_exits: set = set()
         self.stages: "queue.Queue[Optional[Stage]]" = queue.Queue()
@@ -144,11 +149,7 @@ class Watcher:
             if proc.chip is not None:
                 self.pool.put(proc.chip)
         for peer in sorted(new_local - old_local):
-            self.procs[peer] = spawn_worker(
-                self.prog,
-                peer,
-                stage.cluster.workers,
-                stage.version,
+            kwargs = dict(
                 strategy=self.strategy,
                 parent=self.runner_id,
                 config_server=self.config_server,
@@ -156,6 +157,13 @@ class Watcher:
                 logdir=self.logdir,
                 quiet=self.quiet,
             )
+            proc = activate_warm(self.warm, peer, stage.cluster.workers,
+                                 stage.version, **kwargs)
+            if proc is None:  # no warm slot ready: cold spawn
+                proc = spawn_worker(self.prog, peer,
+                                    stage.cluster.workers, stage.version,
+                                    **kwargs)
+            self.procs[peer] = proc
         print(
             f"[kfrun] epoch {stage.version}: {len(self.procs)} local "
             f"worker(s) of {len(stage.cluster.workers)}",
@@ -199,6 +207,10 @@ class Watcher:
                 if code is not None:
                     self._shutdown()
                     return code
+                # keep enough warm slots for the largest possible join
+                # wave; spawned during steady state, never in a resize
+                self.warm.target = max(0, self.slots - len(self.procs))
+                self.warm.refill()
                 if not self.procs and not self.keep \
                         and self.current_version >= 0 \
                         and self.stages.empty():
@@ -209,6 +221,7 @@ class Watcher:
             self.control.close()
 
     def _shutdown(self):
+        self.warm.shutdown()
         for proc in self.procs.values():
             proc.terminate()
         deadline = time.time() + 5.0
