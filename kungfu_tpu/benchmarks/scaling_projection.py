@@ -1,0 +1,177 @@
+"""Projected ResNet-50 data-parallel scaling efficiency from compiled HLO.
+
+BASELINE.md's north-star (>=90% scaling efficiency on a pod slice,
+reference: README.md:184-193 scaling tables) cannot be MEASURED here —
+the environment has one chip — but it can be PREDICTED falsifiably: the
+per-step all-reduce traffic is read off the actual compiled SPMD program
+(not estimated from a parameter count), the interconnect model is the
+public v5e ICI spec, and the single-chip compute time is this repo's
+measured step time. A real pod run can check every number.
+
+Method:
+1. Build THE SAME SyncSGD ResNet-50 train step `bench.py` measures, jit
+   it over an 8-device data mesh (virtual CPU devices — SPMD
+   partitioning is topology-independent), compile, and walk the
+   optimized HLO for `all-reduce` ops, summing their element bytes.
+   This captures what XLA actually inserts: gradient psums, the
+   BatchNorm cross-replica stat syncs, loss pmean — everything.
+2. Ring all-reduce puts 2*B*(n-1)/n bytes on the wire per chip for a
+   B-byte buffer (the standard bidirectional-ring bound the scaling
+   book derives; XLA's ICI all-reduce achieves it on torus meshes).
+3. comm_ms(n) = wire_bytes(n) / ICI_BW; efficiency bounds:
+   - full overlap (XLA's latency-hiding scheduler overlaps grad
+     all-reduce with remaining backward compute):
+       eff = compute / max(compute, comm)
+   - zero overlap (worst case): eff = compute / (compute + comm)
+
+Assumptions (stated so the prediction is falsifiable):
+- ICI_BW = 200 GB/s per chip aggregate (public v5e spec: 1600 Gbps
+  inter-chip interconnect; 2D torus).
+- compute_ms = the measured single-chip step (BASELINE
+  resnet50_syncsgd_tpu_v5e_1chip: 49.7 ms at batch 128) — i.e. weak
+  scaling, per-chip batch held constant.
+- n <= 256 stays on one v5e ICI slice (no DCN hop).
+
+Run: python -m kungfu_tpu.benchmarks.scaling_projection
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+ICI_BYTES_PER_S = 200e9          # v5e: 1600 Gbps aggregate per chip
+MEASURED_STEP_MS = 49.7          # BASELINE resnet50_syncsgd 1-chip
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "s32": 4,
+                "u32": 4, "s8": 1, "u8": 1, "pred": 1, "s64": 8,
+                "u64": 8}
+
+
+def _shape_bytes(shape: str) -> int:
+    """HLO shape string -> bytes, e.g. 'f32[64,3,7,7]' -> 37632."""
+    m = re.match(r"(\w+)\[([\d,]*)\]", shape)
+    if not m:
+        return 0
+    dt, dims = m.groups()
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dt, 4)
+
+
+def all_reduce_bytes_from_hlo(hlo_text: str):
+    """Sum the payload bytes of every all-reduce in optimized HLO.
+
+    Returns (total_bytes, ops) where ops is a list of (shape, bytes)
+    for inspection. Tuple-shaped all-reduces (XLA combines buffers)
+    count every operand.
+    """
+    total, ops = 0, []
+    for line in hlo_text.splitlines():
+        if "all-reduce(" not in line and "all-reduce-start(" not in line:
+            continue
+        # LHS of "x = <shape> all-reduce(...)" — possibly a tuple
+        lhs = line.split("=", 1)[0] + "=" + \
+            line.split("=", 1)[1].split("all-reduce")[0]
+        shapes = re.findall(r"\w+\[[\d,]*\]", lhs)
+        b = sum(_shape_bytes(s) for s in shapes)
+        total += b
+        ops.append((" ".join(shapes[:4]), b))
+    return total, ops
+
+
+def build_and_extract(n_devices: int = 8):
+    """Compile the bench train step over an n-device mesh; return the
+    per-chip all-reduce payload bytes XLA inserted."""
+    import os
+
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kungfu_tpu.models import ResNet50
+    from kungfu_tpu.optimizers import sync_sgd
+    from kungfu_tpu.parallel import (
+        build_train_step_with_state,
+        data_mesh,
+        init_worker_state,
+        replicate_to_workers,
+        shard_batch,
+    )
+
+    devices = jax.devices("cpu")[:n_devices]
+    mesh = data_mesh(n_devices, devices=devices)
+    with jax.default_device(devices[0]):
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                         space_to_depth=True)
+        # per-chip batch 2 keeps the CPU compile tractable; gradient
+        # and BN-stat all-reduce sizes do not depend on batch size
+        x = jnp.ones((2 * n_devices, 224, 224, 3), jnp.float32)
+        y = jnp.zeros((2 * n_devices,), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)
+        params, bstats = variables["params"], variables["batch_stats"]
+
+        def loss_fn(params, batch_stats, batch):
+            logits, updated = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                batch["x"], train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"]).mean()
+            return loss, updated["batch_stats"]
+
+        tx = sync_sgd(optax.sgd(0.1, momentum=0.9))
+        params_s = replicate_to_workers(params, mesh)
+        stats_s = replicate_to_workers(bstats, mesh)
+        opt_s = init_worker_state(tx, params_s, mesh)
+        step = build_train_step_with_state(loss_fn, tx, mesh)
+        batch_s = shard_batch({"x": x, "y": y}, mesh)
+        compiled = jax.jit(step).lower(params_s, stats_s, opt_s,
+                                       batch_s).compile()
+    hlo = compiled.as_text()
+    return all_reduce_bytes_from_hlo(hlo)
+
+
+def project(payload_bytes: int, compute_ms: float = MEASURED_STEP_MS,
+            ici_bytes_per_s: float = ICI_BYTES_PER_S):
+    """Efficiency bounds at n chips for a ring all-reduce of
+    `payload_bytes` per step."""
+    rows = {}
+    for n in (8, 16, 32, 256):
+        wire = 2 * payload_bytes * (n - 1) / n
+        comm_ms = wire / ici_bytes_per_s * 1e3
+        rows[f"n{n}"] = {
+            "wire_bytes_per_chip": int(wire),
+            "comm_ms": round(comm_ms, 3),
+            "efficiency_full_overlap": round(
+                compute_ms / max(compute_ms, comm_ms), 4),
+            "efficiency_zero_overlap": round(
+                compute_ms / (compute_ms + comm_ms), 4),
+        }
+    return rows
+
+
+def main() -> int:
+    total, ops = build_and_extract(8)
+    big = sorted(ops, key=lambda o: -o[1])[:6]
+    result = {
+        "all_reduce_payload_bytes_per_step": total,
+        "all_reduce_op_count": len(ops),
+        "largest_ops": [{"shape": s, "bytes": b} for s, b in big],
+        "assumptions": {
+            "ici_bytes_per_s": ICI_BYTES_PER_S,
+            "compute_ms_single_chip": MEASURED_STEP_MS,
+            "collective_model": "bidirectional ring: 2*B*(n-1)/n wire "
+                                "bytes per chip",
+            "hardware_claim": False,
+        },
+        "projection": project(total),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
