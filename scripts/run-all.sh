@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# The full local CI gate: one command reproduces everything the suite
+# checks, mirroring the reference's pipeline (reference:
+# .github/workflows/ci.yaml:27-41 — build, unit tests, integration
+# sweep, examples) including its np x strategy integration sweep
+# (reference: scripts/tests/run-integration-tests.sh:18-40).
+#
+# Usage: scripts/run-all.sh [--quick]
+#   --quick  skip the pytest suite (sweep + examples only)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+QUICK=0
+[ "${1:-}" = "--quick" ] && QUICK=1
+
+echo "== [1/4] native build + C++ smoke =="
+make -C kungfu_tpu/native -j"$(nproc)"
+make -C kungfu_tpu/native test
+
+if [ "$QUICK" = 0 ]; then
+  echo "== [2/4] pytest suite =="
+  # per-test timeouts need pytest-timeout (CI installs it); locally the
+  # suite runs without it rather than failing on the missing plugin
+  if python -c "import pytest_timeout" 2>/dev/null; then
+    python -m pytest tests/ -q --timeout=900
+  else
+    timeout 2700 python -m pytest tests/ -q
+  fi
+else
+  echo "== [2/4] pytest suite skipped (--quick) =="
+fi
+
+echo "== [3/4] integration sweep: np x strategy =="
+# the reference sweeps np=1..4 x all strategies with a per-run timeout
+# (run-integration-tests.sh:18-40); same sweep, same fake trainer idea
+export JAX_PLATFORMS=cpu
+export KF_LOG_LEVEL=warn
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+for np in 1 2 3 4; do
+  for strategy in STAR RING CLIQUE TREE BINARY_TREE BINARY_TREE_STAR \
+                  MULTI_BINARY_TREE_STAR AUTO; do
+    echo "-- np=$np strategy=$strategy"
+    timeout 60 python -m kungfu_tpu.run \
+      -np "$np" -H "127.0.0.1:$np" -strategy "$strategy" \
+      -port-range 26000-26999 -logdir .kf-ci-logs -q \
+      -- python tests/workers/fake_trainer.py \
+      || { echo "SWEEP FAILED: np=$np strategy=$strategy"; exit 1; }
+  done
+done
+
+echo "== [4/4] examples smoke =="
+timeout 300 python examples/mnist_slp_sync.py --steps 20
+timeout 300 python examples/mnist_elastic.py --launch \
+  --schedule 3:2,3:3 --steps 6
+
+echo "ALL GREEN"
