@@ -333,7 +333,16 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     dob = _bh(g)
     # delta_i = rowsum(dO * O): one cheap elementwise pass, shared by
     # both kernels (FlashAttention-2 eq. 4); lane-broadcast alongside
-    # lse so the kernels get Mosaic-tileable [block_q, _LANES] blocks
+    # lse so the kernels get Mosaic-tileable [block_q, _LANES] blocks.
+    # NOTE the broadcast materializes lse/delta at [B*H, T, 128] f32 in
+    # HBM — a 128x constant factor on two O(T) row vectors (~100 MB
+    # each at B*H=8, T=32k) that the O(T)-not-O(T^2) memory claim
+    # absorbs but doesn't hide: the asymptotic win over [T, T] scores
+    # holds (at T=32k, 4 GB/head-batch), and XLA usually fuses the
+    # broadcast into the kernel's HBM reads. An in-kernel lane
+    # broadcast from [B*H, T] refs would drop the factor; Mosaic
+    # currently rejects that block shape, so the trade is documented
+    # rather than taken.
     delta = jnp.sum(dob.astype(jnp.float32)
                     * _bh(o).astype(jnp.float32), axis=-1)  # [BH, T]
     lse3 = jnp.broadcast_to(lse[:, :, None], (b * h, t, _LANES))
