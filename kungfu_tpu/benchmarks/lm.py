@@ -14,6 +14,7 @@ Prints one JSON line: tokens/sec (global), ms/step, config.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import time
 
@@ -27,8 +28,15 @@ SIZES = {
 
 def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
                     tp: int = 1, attention: str = "local",
-                    iters: int = 10, warmup: int = 2):
-    """Tokens/sec of LM training. Returns (tokens_per_sec, meta)."""
+                    iters: int = 10, warmup: int = 2, experts: int = 0):
+    """Tokens/sec of LM training. Returns (tokens_per_sec, meta).
+
+    `experts` > 0 swaps the dense FFN for the Switch MoE (global expert
+    stacks, GSPMD-sharded over the model axis) and trains through
+    `gpt_loss_with_aux` so the measured step includes the router's
+    load-balance + z losses — the real trainable-MoE path, not a
+    routing demo.
+    """
     import numpy as np
 
     import jax
@@ -36,9 +44,11 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     import optax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from kungfu_tpu.models import GPTConfig, GPTLM, gpt_loss
+    from kungfu_tpu.models import (GPTConfig, GPTLM, gpt_loss,
+                                   gpt_loss_with_aux)
     from kungfu_tpu.parallel import (build_gspmd_train_step,
-                                     gpt_tp_rules, shard_params)
+                                     gpt_moe_rules, gpt_tp_rules,
+                                     shard_params)
 
     n = jax.device_count()
     platform = jax.devices()[0].platform
@@ -52,7 +62,7 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
                     num_layers=layers, num_heads=heads,
                     intermediate_size=inter,
                     max_position=max(1024, seq), dtype=jnp.bfloat16,
-                    attention=attention)
+                    attention=attention, num_experts=experts)
     model = GPTLM(cfg)
 
     d_data = n // tp
@@ -60,20 +70,30 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
                 ("data", "model"))
     tokens = jnp.zeros((batch * d_data, seq), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens[:1, :seq])["params"]
-    params = shard_params(jax.device_get(params), mesh, gpt_tp_rules())
+    rules = gpt_moe_rules() if experts else gpt_tp_rules()
+    params = shard_params(jax.device_get(params), mesh, rules)
     tokens = jax.device_put(tokens, NamedSharding(mesh, P("data")))
 
     tx = optax.adamw(1e-4)
     opt = tx.init(params)
-    step = build_gspmd_train_step(
-        lambda p, t: gpt_loss(model.apply({"params": p}, t), t), tx)
+    if experts:
+        step = build_gspmd_train_step(
+            lambda p, t: gpt_loss_with_aux(model, p, t), tx,
+            has_aux=True)
+    else:
+        step = build_gspmd_train_step(
+            lambda p, t: gpt_loss(model.apply({"params": p}, t), t), tx)
+
+    def one(params, opt, tokens):
+        out = step(params, opt, tokens)
+        return out[0], out[1], out[2]  # params, opt, loss
 
     for _ in range(max(warmup, 1)):
-        params, opt, loss = step(params, opt, tokens)
+        params, opt, loss = one(params, opt, tokens)
     float(loss)  # fence: async dispatch must drain before timing
     t0 = time.perf_counter()
     for _ in range(iters):
-        params, opt, loss = step(params, opt, tokens)
+        params, opt, loss = one(params, opt, tokens)
     float(loss)
     dt = (time.perf_counter() - t0) / iters
     global_tokens = batch * d_data * seq
@@ -82,7 +102,84 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
         "per_data_batch": batch, "seq": seq, "attention": attention,
         "step_time_ms": round(dt * 1000, 2), "iters": iters,
     }
+    if experts:
+        meta["num_experts"] = experts
+        meta["loss_includes_router_aux"] = True
     return global_tokens / dt, meta
+
+
+def measure_pp_rate(size: str = "small", batch: int = 8, seq: int = 1024,
+                    pp: int = 1, microbatches: int = 8, iters: int = 10,
+                    warmup: int = 2):
+    """Tokens/sec of GPT training under the 1F1B pipeline schedule.
+
+    With pp devices each holding layers/pp blocks; at pp=1 this measures
+    the schedule's overhead against the plain GSPMD step (the 1F1B loop
+    is then gradient accumulation over `microbatches`), which is the
+    honest single-chip row — multi-stage speedup needs >= 2 devices.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from kungfu_tpu.models import GPTConfig, GPTLM, stack_gpt_blocks
+    from kungfu_tpu.models.gpt import gpt_pipeline_train_step
+
+    n = jax.device_count()
+    platform = jax.devices()[0].platform
+    if platform == "cpu":  # smoke path
+        size, batch, seq, microbatches = "tiny", 4, 128, 2
+        iters, warmup = min(iters, 3), min(warmup, 1)
+        pp = min(pp, SIZES[size][1])  # tiny has 2 layers
+    if pp > n:
+        raise SystemExit(f"--pp {pp} exceeds device count {n}")
+    hidden, layers, heads, inter = SIZES[size]
+    cfg = GPTConfig(vocab_size=50257, hidden_size=hidden,
+                    num_layers=layers, num_heads=heads,
+                    intermediate_size=inter,
+                    max_position=max(1024, seq), dtype=jnp.bfloat16)
+    model = GPTLM(cfg)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+    outer, stacked = stack_gpt_blocks(params, pp)
+    mesh = Mesh(np.array(jax.devices()[:pp]), ("pipe",))
+    mapped = shard_map(
+        lambda o, s, t: gpt_pipeline_train_step(
+            cfg, o, s, t, "pipe", num_microbatches=microbatches),
+        mesh=mesh, in_specs=(P(), P("pipe"), P()),
+        out_specs=(P(), P(), P("pipe")), check_vma=False)
+    tx = optax.adamw(1e-4)  # stateless transformation: one serves both
+    so, ss = tx.init(outer), tx.init(stacked)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def step(outer, stacked, so, ss, t):
+        loss, g_o, g_s = mapped(outer, stacked, t)
+        uo, so = tx.update(g_o, so, outer)
+        us, ss = tx.update(g_s, ss, stacked)
+        return (optax.apply_updates(outer, uo),
+                optax.apply_updates(stacked, us), so, ss, loss)
+
+    for _ in range(max(warmup, 1)):
+        outer, stacked, so, ss, loss = step(outer, stacked, so, ss,
+                                            tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        outer, stacked, so, ss, loss = step(outer, stacked, so, ss,
+                                            tokens)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    meta = {
+        "platform": platform, "devices": n, "pp": pp, "size": size,
+        "batch": batch, "seq": seq, "microbatches": microbatches,
+        "schedule": "1F1B", "step_time_ms": round(dt * 1000, 2),
+        "iters": iters,
+    }
+    return batch * seq / dt, meta
 
 
 def measure_decode_rate(size: str = "small", batch: int = 8,
@@ -135,6 +232,13 @@ def main():
     ap.add_argument("--attention", default="local",
                     choices=["local", "flash"])
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--experts", type=int, default=0,
+                    help="Switch-MoE FFN with this many experts "
+                         "(trains via gpt_loss_with_aux)")
+    ap.add_argument("--pp", type=int, default=0,
+                    help="1F1B pipeline over this many stages")
+    ap.add_argument("--microbatches", type=int, default=8,
+                    help="(--pp) microbatches in flight")
     ap.add_argument("--decode", action="store_true",
                     help="measure KV-cached generation instead of "
                          "training")
@@ -155,8 +259,17 @@ def main():
                           "value": round(rate, 1),
                           "unit": "tokens/sec", "details": meta}))
         return
+    if args.pp:
+        rate, meta = measure_pp_rate(args.size, args.batch, args.seq,
+                                     args.pp, args.microbatches,
+                                     iters=args.iters)
+        print(json.dumps({"metric": "gpt_pp_tokens_per_sec",
+                          "value": round(rate, 1), "unit": "tokens/sec",
+                          "details": meta}))
+        return
     rate, meta = measure_lm_rate(args.size, args.batch, args.seq,
-                                 args.tp, args.attention, args.iters)
+                                 args.tp, args.attention, args.iters,
+                                 experts=args.experts)
     print(json.dumps({"metric": "gpt_tokens_per_sec",
                       "value": round(rate, 1), "unit": "tokens/sec",
                       "details": meta}))
