@@ -1,0 +1,75 @@
+"""Trainable Mixture-of-Experts GPT: Switch routing with the losses
+that keep it honest.
+
+Beyond the reference's scope (it ships no MoE): a GPT whose FFN is a
+Switch top-1 expert layer, expert stacks GSPMD-sharded over the "model"
+mesh axis, trained through `gpt_loss_with_aux` so the router's
+load-balance and z losses are part of the objective — without them a
+top-1 router collapses onto a few experts and the capacity drop
+silently eats tokens. The printed metrics show load entropy staying
+near uniform while the LM loss drops. Run on the virtual CPU mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/gpt_moe.py
+
+or on a real TPU slice (mesh shape adapts to the device count).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kungfu_tpu.models import GPTConfig, GPTLM, gpt_loss_with_aux
+from kungfu_tpu.parallel import (build_gspmd_train_step, gpt_moe_rules,
+                                 shard_params)
+
+
+def main():
+    n = jax.device_count()
+    d_model = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    d_data = n // d_model
+    mesh = Mesh(np.array(jax.devices()).reshape(d_data, d_model),
+                ("data", "model"))
+    print(f"mesh: {d_data} data x {d_model} model "
+          f"({jax.devices()[0].platform})")
+
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=8, intermediate_size=256, max_position=128,
+                    dtype=jnp.float32, num_experts=8,
+                    moe_capacity_factor=1.25)
+    model = GPTLM(cfg)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (8 * d_data, 64)))
+
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+    params = shard_params(jax.device_get(params), mesh, gpt_moe_rules())
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("data")))
+
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    step = build_gspmd_train_step(
+        lambda p, t: gpt_loss_with_aux(model, p, t), tx, has_aux=True)
+
+    for i in range(60):
+        params, opt, loss, m = step(params, opt, tokens)
+        if i % 10 == 0 or i == 59:
+            load = np.asarray(m["expert_load"], np.float64)
+            load = load / load.sum()
+            entropy = float(-(load * np.log(load + 1e-9)).sum())
+            print(f"step {i:3d}  ce {float(m['ce']):.4f}  "
+                  f"balance {float(m['load_balance']):.3f}  "
+                  f"dropped {float(m['dropped_frac']):.3f}  "
+                  f"load-entropy {entropy:.3f}"
+                  f"/{np.log(cfg.num_experts):.3f}")
+    print("a load_balance near 1.0 and entropy near ln(E) mean every "
+          "expert pulls its weight; try moe_aux_coef=0 to watch the "
+          "router collapse")
+
+
+if __name__ == "__main__":
+    main()
