@@ -78,12 +78,20 @@ def main(argv=None) -> int:
             return 2
         module, rest = argv[1], argv[2:]
         sys.argv = [module] + rest
+        # sys.path[0] is already the cwd: this process was itself
+        # launched with `python -m`, the same layout cold `python -m
+        # <module>` would produce
         try:
             runpy.run_module(module, run_name="__main__", alter_sys=True)
         except SystemExit as e:
             return _exit_code(e)
         return 0
     sys.argv = argv
+    # cold `python script.py` puts the SCRIPT'S directory at sys.path[0]
+    # (how examples import their sibling common.py) and does NOT expose
+    # the cwd; REPLACE the cwd entry this process's own `python -m`
+    # launch left there, so warm == cold exactly
+    sys.path[0] = os.path.dirname(os.path.abspath(argv[0]))
     try:
         runpy.run_path(argv[0], run_name="__main__")
     except SystemExit as e:

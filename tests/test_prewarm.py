@@ -72,6 +72,21 @@ def test_activation_latency_is_subsecond(tmp_path):
     assert dt < 1.5, f"warm activation took {dt:.2f}s"
 
 
+def test_sibling_import_works_like_cold_python(tmp_path):
+    """`python script.py` puts the script's directory on sys.path, so
+    scripts import sibling modules (every example imports common.py).
+    Warm activation must behave identically — regression for the CI
+    gate's mnist_elastic failure under a prewarm-activated worker."""
+    (tmp_path / "sibling.py").write_text("VALUE = 41\n")
+    proc = spawn_prewarm(tmp_path, """
+        from sibling import VALUE
+        print("GOT", VALUE + 1)
+        """)
+    out, _ = proc.communicate(input=b"{}\n", timeout=60)
+    assert proc.returncode == 0, out
+    assert b"GOT 42" in out
+
+
 def test_warm_pool_gating():
     assert _is_python_prog([sys.executable, "-m", "x"])
     assert not _is_python_prog(["/bin/sleep", "1"])
