@@ -24,11 +24,13 @@ from .sequence import (heads_to_seq, ring_attention, seq_to_heads,
 from .bootstrap import init_distributed, shutdown_distributed
 from .expert import (MoEParams, dispatch_tensors, init_moe_params,
                      moe_capacity, moe_mlp)
-from .pipeline import pipeline_apply, stack_stage_params
+from .pipeline import (pipeline_apply, pipeline_train_step_1f1b,
+                       stack_stage_params)
 from .tensor import (bert_tp_rules, gpt_moe_rules, gpt_tp_rules,
                      shard_params)
 from .train import (build_eval_step, build_gspmd_train_step,
                     build_train_step, build_train_step_with_state)
+from .zero import zero1_shard_opt_state
 
 __all__ = [
     "data_mesh",
@@ -56,6 +58,8 @@ __all__ = [
     "gpt_tp_rules",
     "gpt_moe_rules",
     "shard_params",
+    "zero1_shard_opt_state",
+    "pipeline_train_step_1f1b",
     "moe_mlp",
     "init_moe_params",
     "MoEParams",

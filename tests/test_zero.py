@@ -1,0 +1,124 @@
+"""ZeRO-1 optimizer-state sharding: numerics and placement.
+
+The TPU-idiomatic ZeRO-1 (parallel/zero.py): annotate moment leaves
+with P("data"), leave params replicated, and XLA's partitioner derives
+the shard-update-allgather schedule. These tests pin (a) the moments
+actually end up 1/n per device and STAY sharded across jitted steps,
+(b) training numerics match the replicated layout, (c) composition
+with tensor parallelism leaves model-sharded axes intact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kungfu_tpu.models import GPTConfig, GPTLM, gpt_loss
+from kungfu_tpu.parallel import (build_gspmd_train_step, gpt_tp_rules,
+                                 shard_params, zero1_shard_opt_state)
+
+CFG = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                num_heads=8, intermediate_size=128, max_position=32,
+                dtype=jnp.float32)
+
+
+def dp_mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n, 1),
+                ("data", "model"))
+
+
+def setup(mesh, rules=None):
+    model = GPTLM(CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 32), 0,
+                                CFG.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    params = shard_params(jax.device_get(params), mesh,
+                          rules if rules is not None else {})
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("data")))
+    tx = optax.adam(1e-2)
+    step = build_gspmd_train_step(
+        lambda p, t: gpt_loss(model.apply({"params": p}, t), t), tx,
+        donate=False)
+    return model, params, tokens, tx, step
+
+
+def data_sharded_leaves(opt_state):
+    out = []
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        if isinstance(leaf, jax.Array) and isinstance(
+                leaf.sharding, NamedSharding):
+            spec = tuple(leaf.sharding.spec)
+            if spec and spec[0] == "data":
+                out.append(leaf)
+    return out
+
+
+def test_moments_shard_and_stay_sharded_across_steps():
+    mesh = dp_mesh()
+    _, params, tokens, tx, step = setup(mesh)
+    opt = zero1_shard_opt_state(tx.init(params), mesh)
+    sharded = data_sharded_leaves(opt)
+    assert sharded, "no optimizer-state leaf was data-sharded"
+    # each device holds 1/n of a sharded moment
+    leaf = sharded[0]
+    shard_rows = leaf.addressable_shards[0].data.shape[0]
+    assert shard_rows == leaf.shape[0] // mesh.shape["data"]
+
+    params, opt, _ = step(params, opt, tokens)
+    again = data_sharded_leaves(opt)
+    assert len(again) >= len(sharded), (
+        "jitted step dropped the ZeRO-1 sharding")
+
+
+def test_numerics_match_replicated_layout():
+    mesh = dp_mesh()
+    _, params, tokens, tx, step = setup(mesh)
+    opt_rep = tx.init(params)
+    opt_z1 = zero1_shard_opt_state(tx.init(params), mesh)
+    p_rep, p_z1 = params, params
+    with jax.default_matmul_precision("highest"):
+        for _ in range(5):
+            p_rep, opt_rep, loss_rep = step(p_rep, opt_rep, tokens)
+            p_z1, opt_z1, loss_z1 = step(p_z1, opt_z1, tokens)
+    np.testing.assert_allclose(float(loss_z1), float(loss_rep),
+                               rtol=1e-6)
+    for (ka, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(p_rep)[0],
+            jax.tree_util.tree_flatten_with_path(p_z1)[0]):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=str(ka))
+
+
+def test_composes_with_tensor_parallelism():
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "model"))
+    _, params, tokens, tx, step = setup(mesh, rules=gpt_tp_rules())
+    opt = zero1_shard_opt_state(tx.init(params), mesh)
+    # a model-sharded moment must keep its model axis; ZeRO only adds
+    # "data" on leading dims that were unsharded and divisible
+    specs = {tuple(leaf.sharding.spec)
+             for leaf in jax.tree_util.tree_leaves(opt)
+             if isinstance(leaf, jax.Array)
+             and isinstance(leaf.sharding, NamedSharding)
+             and any(s is not None for s in tuple(leaf.sharding.spec))}
+    assert any("model" in s for s in specs), specs
+    assert any(s and s[0] == "data" for s in specs), specs
+    params, opt, loss = step(params, opt, tokens)
+    assert np.isfinite(float(loss))
+
+
+def test_indivisible_and_scalar_leaves_untouched():
+    mesh = dp_mesh(8)
+    state = {
+        "count": jnp.zeros((), jnp.int32),
+        "odd": jnp.ones((7, 3)),        # 7 % 8 != 0
+        "even": jnp.ones((16, 3)),
+    }
+    out = zero1_shard_opt_state(state, mesh)
+    assert tuple(out["even"].sharding.spec) == ("data", None)
+    for k in ("count", "odd"):
+        spec = getattr(out[k].sharding, "spec", None)
+        assert spec is None or not any(s == "data" for s in tuple(spec))
