@@ -77,3 +77,43 @@ def shard_slice(num_samples: int, rank: int, size: int) -> Tuple[int, int]:
     begin = rank * per + min(rank, rem)
     end = begin + per + (1 if rank < rem else 0)
     return begin, end
+
+
+def prefetch_to_device(iterator, size: int = 2, sharding=None):
+    """Wrap a host batch iterator so the next `size` batches are already
+    on device while the current step computes.
+
+    `jax.device_put` is asynchronous: enqueueing the host->HBM DMA for
+    upcoming batches lets the transfer overlap the running step instead
+    of serializing in front of it — the standard TPU input-pipeline
+    pattern, here for GSPMD layouts: pass a `NamedSharding` (or a pytree
+    of them matching the batch structure) and batches land pre-sharded
+    for the jitted step, e.g.
+    `NamedSharding(mesh, P("data"))` for the dp batch axis.
+
+    Keeps `size` batches in flight; order is preserved; stops when the
+    underlying iterator does.
+    """
+    import collections
+
+    import jax
+
+    def put(batch):
+        if sharding is not None:
+            return jax.device_put(batch, sharding)
+        return jax.device_put(batch)
+
+    it = iter(iterator)
+    queue: "collections.deque" = collections.deque()
+    try:
+        while len(queue) < max(size, 1):
+            queue.append(put(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

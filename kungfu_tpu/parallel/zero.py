@@ -61,8 +61,16 @@ def zero1_shard_opt_state(opt_state, mesh, axis_name: str = "data"):
     def reshard(leaf):
         if not isinstance(leaf, jax.Array):
             return leaf
-        existing = (leaf.sharding
-                    if isinstance(leaf.sharding, NamedSharding) else None)
+        if not isinstance(leaf.sharding, NamedSharding):
+            # a sharded non-NamedSharding leaf (e.g. GSPMDSharding from
+            # another producer) can't be inspected for existing axes;
+            # resharding it blindly could REPLICATE a former model axis
+            # — skip rather than silently regress memory
+            if not leaf.sharding.is_fully_replicated:
+                return leaf
+            existing = None
+        else:
+            existing = leaf.sharding
         spec = _zero1_spec(leaf, existing, axis_name, axis_size)
         if spec is None:
             return leaf

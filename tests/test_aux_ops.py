@@ -174,6 +174,50 @@ class TestMultiPeerTopology:
                 p.close()
 
 
+class TestPrefetchToDevice:
+    def test_order_and_completeness(self):
+        import jax
+
+        from kungfu_tpu.data import prefetch_to_device
+
+        batches = [{"x": np.full((4,), i, np.float32)} for i in range(7)]
+        out = list(prefetch_to_device(iter(batches), size=3))
+        assert len(out) == 7
+        for i, b in enumerate(out):
+            assert float(b["x"][0]) == i
+            assert isinstance(b["x"], jax.Array)  # actually on device
+
+    def test_lands_with_requested_sharding(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from kungfu_tpu.data import prefetch_to_device
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        sharding = NamedSharding(mesh, P("data"))
+        batches = [np.ones((16, 3), np.float32) for _ in range(3)]
+        for b in prefetch_to_device(iter(batches), size=2,
+                                    sharding=sharding):
+            assert b.sharding == sharding
+            assert b.addressable_shards[0].data.shape[0] == 2  # 16/8
+
+    def test_short_iterator(self):
+        from kungfu_tpu.data import prefetch_to_device
+
+        assert list(prefetch_to_device(iter([]), size=2)) == []
+        one = list(prefetch_to_device(iter([np.ones(2)]), size=4))
+        assert len(one) == 1
+
+    def test_composes_with_elastic_sampler(self):
+        from kungfu_tpu.data import prefetch_to_device
+
+        data = np.arange(64, dtype=np.float32)
+        sampler = ElasticSampler(64, 4, rank=0, size=2, seed=3)
+        it = (data[idx] for idx in sampler)
+        first = next(prefetch_to_device(it, size=2))
+        assert first.shape == (4,)
+
+
 class _FakePeer:
     rank = 0
 
