@@ -37,12 +37,19 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     attention: str = "local"  # local | ring | ulysses
     seq_axis: str = "seq"     # mesh axis for the sequence-parallel modes
+    # run the sharded mixer's local step through the Pallas flash
+    # kernel (ring: flash per hop; ulysses: flash over the head subset)
+    use_flash: bool = False
 
     def __post_init__(self):
         if self.attention not in ("local", "ring", "ulysses"):
             raise ValueError(
                 f"attention must be local|ring|ulysses, got "
                 f"{self.attention!r}")
+        if self.use_flash and self.attention == "local":
+            raise ValueError(
+                "use_flash modifies the 'ring'/'ulysses' mixers; it "
+                "does nothing for attention='local'")
 
 
 class SeqParallelAttention(nn.Module):
@@ -62,7 +69,8 @@ class SeqParallelAttention(nn.Module):
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
         mixer = (ring_attention if c.attention == "ring"
                  else ulysses_attention)
-        out = mixer(q, k, v, c.seq_axis, causal=False)
+        out = mixer(q, k, v, c.seq_axis, causal=False,
+                    use_flash=c.use_flash)
         return nn.DenseGeneral(c.hidden_size, axis=(-2, -1), dtype=c.dtype,
                                name="out")(out)
 

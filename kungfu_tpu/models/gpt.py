@@ -60,11 +60,11 @@ class GPTConfig:
     # follow Switch/ST-MoE (1e-2, 1e-3).
     moe_aux_coef: float = 1e-2
     moe_z_coef: float = 1e-3
-    # attention="ulysses" only: run the per-head-subset local mixer
-    # through the Pallas flash kernel. Trains end-to-end: the Ulysses
-    # all-to-alls use tiled=True, sidestepping the upstream JAX grad
-    # miscompile of the reshape-wrapped tiled=False form (repro +
-    # details in docs/long_context.md).
+    # attention="ulysses"|"ring": run the sharded mixer's local step
+    # through the Pallas flash kernel. Ulysses trains end-to-end via
+    # tiled=True all-to-alls (docs/long_context.md has the upstream-bug
+    # repro the layout sidesteps); ring runs flash per hop with a
+    # hand-written global-lse backward (parallel/sequence.py).
     use_flash: bool = False
     # routing group size (GShard/Switch): tokens route within fixed-size
     # groups so dispatch/combine tensors stay LINEAR in total tokens
@@ -81,10 +81,11 @@ class GPTConfig:
         if self.hidden_size % self.num_heads:
             raise ValueError(
                 f"hidden {self.hidden_size} % heads {self.num_heads} != 0")
-        if self.use_flash and self.attention not in ("ulysses", "flash"):
+        if self.use_flash and self.attention not in ("ulysses", "ring",
+                                                     "flash"):
             raise ValueError(
-                "use_flash only modifies the 'ulysses' local mixer; for "
-                f"attention={self.attention!r} use attention='flash' "
+                "use_flash modifies the 'ulysses' and 'ring' mixers; "
+                f"for attention={self.attention!r} use attention='flash' "
                 "instead (the non-sharded flash mode, where the flag is "
                 "redundant but accepted)")
 
@@ -180,7 +181,8 @@ class CausalSelfAttention(nn.Module):
             )
 
             if c.attention == "ring":
-                out = ring_attention(q, k, v, c.seq_axis, causal=True)
+                out = ring_attention(q, k, v, c.seq_axis, causal=True,
+                                     use_flash=c.use_flash)
             else:
                 out = ulysses_attention(q, k, v, c.seq_axis,
                                         causal=True,

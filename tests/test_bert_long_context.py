@@ -31,9 +31,11 @@ def run_on_axis(model, params, tokens, n_dev):
     return jax.jit(fwd)(params, tokens)
 
 
-@pytest.mark.parametrize("attention", ["ring", "ulysses"])
-def test_sharded_matches_single_device(attention):
-    cfg = BertConfig(attention=attention, **CFG)
+@pytest.mark.parametrize("attention,use_flash", [
+    ("ring", False), ("ulysses", False),
+    ("ring", True), ("ulysses", True)])
+def test_sharded_matches_single_device(attention, use_flash):
+    cfg = BertConfig(attention=attention, use_flash=use_flash, **CFG)
     model = BertEncoder(cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(0), (B, T), 0,
                                 cfg.vocab_size)

@@ -74,12 +74,16 @@ def test_flash_variant_matches_local():
                                rtol=5e-3, atol=5e-3)
 
 
-@pytest.mark.parametrize("mode", ["ring", "ulysses"])
-def test_sequence_parallel_matches_local(mode):
+@pytest.mark.parametrize("mode,flash", [("ring", False),
+                                        ("ulysses", False),
+                                        ("ring", True),
+                                        ("ulysses", True)])
+def test_sequence_parallel_matches_local(mode, flash):
     model, params, tokens = make(seq=32)
     ref = model.apply({"params": params}, tokens)
 
-    sp_cfg = GPTConfig(**{**CFG.__dict__, "attention": mode})
+    sp_cfg = GPTConfig(**{**CFG.__dict__, "attention": mode,
+                          "use_flash": flash})
     sp_model = GPTLM(sp_cfg)
     mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
     mapped = shard_map(
