@@ -41,30 +41,47 @@ def _rowvals(ref_blk, width):
     return tiled if tiled.shape[1] == width else tiled[:, :width]
 
 
-def _scores(q_blk, k_blk, iq, jk, *, scale, causal, block_q, block_k):
-    """Scaled (and causal-masked) score block [block_q, block_k] —
-    shared by the forward and both backward kernels so the masking and
-    scaling semantics cannot drift apart."""
+def _scores(q_blk, k_blk, iq, jk, *, scale, causal, block_q, block_k,
+            window=None):
+    """Scaled (and causal/window-masked) score block [block_q, block_k]
+    — shared by the forward and both backward kernels so the masking
+    and scaling semantics cannot drift apart.
+
+    `window` (sliding-window attention, causal only): position q
+    attends to keys [q - window, q]. Self is always visible, so no row
+    is ever fully masked.
+    """
     s = jax.lax.dot_general(
         q_blk.astype(jnp.float32) * scale, k_blk.astype(jnp.float32),
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    if causal:
+    if causal or window is not None:
         q_pos = iq * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = jk * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        keep = q_pos >= k_pos
+        if window is not None:
+            keep &= q_pos - k_pos <= window
+        s = jnp.where(keep, s, NEG_INF)
     return s
 
 
-def _diag_ok(iq, jk, causal, block_q, block_k):
-    """False only for causal K blocks entirely above the diagonal."""
-    return (jk * block_k <= (iq + 1) * block_q - 1) if causal else True
+def _diag_ok(iq, jk, causal, block_q, block_k, window=None):
+    """False for blocks with no visible entries: causal K blocks
+    entirely above the diagonal, and (with a sliding window) K blocks
+    entirely below the window — those are SKIPPED, which is what makes
+    windowed attention O(T * window) compute instead of O(T^2)."""
+    ok = (jk * block_k <= (iq + 1) * block_q - 1) if causal else True
+    if window is not None:
+        # newest key of this block still within the OLDEST query's reach
+        win_ok = jk * block_k + block_k - 1 >= iq * block_q - window
+        ok = win_ok if ok is True else jnp.logical_and(ok, win_ok)
+    return ok
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-            scale, causal, block_q, block_k):
+            scale, causal, block_q, block_k, window=None):
     """Grid (B*H, nq, nk), nk innermost: the VMEM scratch (accumulator +
     running max/denominator) carries the online-softmax state across the
     sequential K-block steps; K/V blocks stream through VMEM one at a
@@ -79,10 +96,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    @pl.when(_diag_ok(iq, jk, causal, block_q, block_k))
+    @pl.when(_diag_ok(iq, jk, causal, block_q, block_k, window))
     def _():
         s = _scores(q_ref[0], k_ref[0], iq, jk, scale=scale,
-                    causal=causal, block_q=block_q, block_k=block_k)
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    window=window)
         v_blk = v_ref[0].astype(jnp.float32)
         m = m_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -112,20 +130,22 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _kernel_nolse(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale, causal, block_q, block_k):
+                  scale, causal, block_q, block_k, window=None):
     _kernel(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref, l_ref,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            window=window)
 
 
-def _plain_attention(q, k, v, causal, scale):
+def _plain_attention(q, k, v, causal, scale, window=None):
     # single reference implementation, shared with the sequence-parallel
     # mixers (sequence.py has no pallas dependency; this module does)
     from ..parallel.sequence import _local_attention
 
-    return _local_attention(q, k, v, causal=causal, scale=scale)
+    return _local_attention(q, k, v, causal=causal, scale=scale,
+                            window=window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -135,6 +155,7 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Attention over [B, T, H, D] without materializing [T, T] scores.
 
@@ -152,9 +173,17 @@ def flash_attention(
     FlashAttention-2 recurrence (p re-materialized per block from the
     saved logsumexp), so both directions are O(T) in HBM. Non-tiling
     shapes fall back to the plain VJP.
+
+    `window` (requires causal=True): sliding-window attention — position
+    q attends to keys [q - window, q] (Mistral-style local attention).
+    K blocks entirely outside the window skip their compute in BOTH
+    directions (O(T * window) FLOPs instead of O(T^2)); measured 2.3x
+    at T=16k, window=512 on v5e (in-graph A/B vs full causal). The gap
+    to the FLOP ratio is the grid: skipped blocks still stream their
+    K/V DMA — an index-map-level skip would close it.
     """
     out, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
-                             interpret, save_lse=False)
+                             interpret, save_lse=False, window=window)
     return out
 
 
@@ -204,23 +233,33 @@ def _unbh(x, b, h):
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
-                    save_lse):
+                    save_lse, window=None):
     """Returns (out, lse) — lse is None on the plain-attention fallback
     or when `save_lse` is False (the no-grad forward skips the extra
     [B*H, T, _LANES] output entirely: no HBM allocation, no writes)."""
+    # validated HERE, not in the custom_vjp primal: under jax.grad the
+    # primal body never runs (custom_vjp routes straight to _flash_fwd,
+    # which also lands here), so a primal-only check would let autodiff
+    # silently compute semantics the caller never asked for
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
     b, t, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     tiles = _tiles(t, causal, block_q, block_k)
     if tiles is None:
-        return _plain_attention(q, k, v, causal, scale), None
+        return _plain_attention(q, k, v, causal, scale,
+                                window=window), None
     block_q, block_k = tiles
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     kernel = functools.partial(
         _kernel if save_lse else _kernel_nolse, scale=scale,
-        causal=causal, block_q=block_q, block_k=block_k)
+        causal=causal, block_q=block_q, block_k=block_k, window=window)
     o_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0))
     o_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
     lse_spec = pl.BlockSpec((1, block_q, _LANES),
@@ -250,7 +289,8 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_ref, *, scale, causal, block_q, block_k):
+                   dq_ref, acc_ref, *, scale, causal, block_q, block_k,
+                   window=None):
     """Grid (B*H, nq, nk), nk innermost: accumulate dq for one Q block
     while K/V/blocks stream by. p is rebuilt from the saved lse, never
     stored: ds = p * (dp - delta); dq += scale * ds @ k."""
@@ -262,13 +302,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(_diag_ok(iq, jk, causal, block_q, block_k))
+    @pl.when(_diag_ok(iq, jk, causal, block_q, block_k, window))
     def _():
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _scores(q_ref[0], k_ref[0], iq, jk, scale=scale,
-                    causal=causal, block_q=block_q, block_k=block_k)
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    window=window)
         p = jnp.exp(s - _rowvals(lse_ref[0], block_k))
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -285,7 +326,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k):
+                    block_q, block_k, window=None):
     """Grid (B*H, nk, nq), nq innermost: accumulate dk/dv for one K/V
     block while Q/dO blocks stream by. dv += p^T @ do;
     dk += scale * ds^T @ q."""
@@ -298,13 +339,14 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(_diag_ok(iq, jk, causal, block_q, block_k))
+    @pl.when(_diag_ok(iq, jk, causal, block_q, block_k, window))
     def _():
         q = q_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _scores(q_ref[0], k_ref[0], iq, jk, scale=scale,
-                    causal=causal, block_q=block_q, block_k=block_k)
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    window=window)
         p = jnp.exp(s - _rowvals(lse_ref[0], block_k))  # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -324,7 +366,7 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, window=None):
     b, t, h, d = q.shape
     block_q, block_k = _tiles(t, causal, block_q, block_k)
     if interpret is None:
@@ -351,7 +393,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     nq, nk = t // block_q, t // block_k
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k)
+        block_k=block_k, window=window)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b * h, nq, nk),
@@ -372,7 +414,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k)
+        block_k=block_k, window=window)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b * h, nk, nq),
@@ -401,9 +443,10 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     return (_unbh(dq, b, h), _unbh(dk, b, h), _unbh(dv, b, h))
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               window):
     out, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
-                               interpret, save_lse=True)
+                               interpret, save_lse=True, window=window)
     if lse is None:  # fallback path (statically decidable from shapes)
         return out, (q, k, v)
     # The residual is carried as [B, T, H, 1] — the same
@@ -417,18 +460,20 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse4)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
+               res, g):
     q, k, v = res[0], res[1], res[2]
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if len(res) == 3:  # shapes didn't tile: mirror the fallback forward
-        _, vjp = jax.vjp(lambda q, k, v: _plain_attention(q, k, v, causal,
-                                                          scale), q, k, v)
+        _, vjp = jax.vjp(
+            lambda q, k, v: _plain_attention(q, k, v, causal, scale,
+                                             window=window), q, k, v)
         return vjp(g)
     o, lse4 = res[3], res[4]
     lse = _bh(lse4)[..., 0]  # [B, T, H, 1] -> [B*H, T]
     return _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q,
-                           block_k, interpret)
+                           block_k, interpret, window=window)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

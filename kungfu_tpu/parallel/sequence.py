@@ -375,16 +375,24 @@ def ulysses_attention(
     return heads_to_seq(out, axis_name)
 
 
-def _local_attention(q, k, v, causal=False, scale=None):
-    """Plain full attention on local tensors, [B, T, H, D]."""
+def _local_attention(q, k, v, causal=False, scale=None, window=None):
+    """Plain full attention on local tensors, [B, T, H, D].
+
+    `window` (causal only): sliding-window mask — position q sees keys
+    [q - window, q]. The single reference implementation for the flash
+    kernel and the sequence-parallel mixers.
+    """
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    if causal:
+    if causal or window is not None:
         t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
+        pos = jnp.arange(t)
+        mask = pos[:, None] >= pos[None, :]
+        if window is not None:
+            mask &= pos[:, None] - pos[None, :] <= window
         s = jnp.where(mask[None, None], s, jnp.finfo(jnp.float32).min)
     w = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32))

@@ -37,6 +37,63 @@ def test_uneven_blocks_within_t():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("t,window,blocks", [
+    (256, 32, (64, 64)),    # window smaller than a block: in-block mask
+    (256, 100, (64, 64)),   # window spans blocks, odd size
+    (256, 64, (128, 64)),   # uneven blocks + whole-block skipping
+    (128, 8, (None, None)),  # auto single-block path
+])
+def test_sliding_window_matches_masked_plain(t, window, blocks):
+    """Mistral-style local attention: position q sees keys [q-window, q].
+    Blocks entirely outside the window are skipped (O(T*window)
+    compute), so both the mask math and the skip logic are under test."""
+    q, k, v = qkv(t=t)
+    bq, bk = blocks
+    out = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk,
+                          window=window)
+    ref = _plain_attention(q, k, v, True, 1.0 / (32 ** 0.5),
+                           window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sliding_window_grads_match_masked_plain():
+    q, k, v = qkv(t=256)
+    g = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+    def loss_flash(q, k, v):
+        return jnp.vdot(flash_attention(q, k, v, causal=True,
+                                        block_q=64, block_k=64,
+                                        window=50), g)
+
+    def loss_ref(q, k, v):
+        return jnp.vdot(_plain_attention(q, k, v, True,
+                                         1.0 / (32 ** 0.5), window=50),
+                        g)
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gp = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), gf, gp):
+        scale = float(jnp.max(jnp.abs(b))) or 1.0
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=2e-5 * scale,
+                                   err_msg=name)
+
+
+def test_window_wider_than_t_equals_causal():
+    q, k, v = qkv(t=128)
+    out = flash_attention(q, k, v, causal=True, window=1000)
+    ref = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_window_requires_causal():
+    q, k, v = qkv(t=128)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=16)
+
+
 def test_bf16_io_f32_accumulate():
     q, k, v = qkv(dtype=jnp.bfloat16, t=128)
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
