@@ -25,6 +25,30 @@ SIZES = {
     "medium": (1024, 24, 16, 4096),  # GPT-2 350M
 }
 
+_V5E_BF16_PEAK = 197e12  # TPU v5e peak bf16 FLOP/s (per chip)
+
+
+def _train_mfu(cfg, tokens_per_sec, platform, seq, n_chips):
+    """Model FLOPs utilization of a train step vs the v5e bf16 peak
+    across `n_chips` chips.
+
+    Standard accounting (PaLM appendix B): 6 FLOPs per ACTIVE matmul
+    parameter per token (fwd+bwd) — attention projections, the MLP (one
+    expert's worth under Switch top-1 routing, however many experts
+    exist), the lm_head — plus the causal attention term
+    6 * L * h * T per token. Embedding lookups are not matmuls and are
+    not counted."""
+    if platform == "cpu":
+        return None
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    per_layer = 4 * h * h + 2 * h * inter  # qkvo + one expert's MLP
+    if cfg.num_experts:
+        per_layer += h * cfg.num_experts   # router projection
+    n_mat = cfg.num_layers * per_layer + h * cfg.vocab_size
+    flops_per_tok = 6 * n_mat + 6 * cfg.num_layers * h * seq
+    peak = _V5E_BF16_PEAK * max(n_chips, 1)
+    return round(tokens_per_sec * flops_per_tok / peak, 4)
+
 
 def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
                     tp: int = 1, attention: str = "local",
@@ -44,8 +68,8 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     import optax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from kungfu_tpu.models import (GPTConfig, GPTLM, gpt_loss,
-                                   gpt_loss_with_aux)
+    from kungfu_tpu.models import (GPTConfig, GPTLM, gpt_fused_loss,
+                                   gpt_loss, gpt_loss_with_aux)
     from kungfu_tpu.parallel import (build_gspmd_train_step,
                                      gpt_moe_rules, gpt_tp_rules,
                                      shard_params)
@@ -77,10 +101,22 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     tx = optax.adamw(1e-4)
     opt = tx.init(params)
     if experts:
+        # fused head only single-chip, same rationale as the dense
+        # branch below
         step = build_gspmd_train_step(
-            lambda p, t: gpt_loss_with_aux(model, p, t), tx,
-            has_aux=True)
+            lambda p, t: gpt_loss_with_aux(model, p, t, fused=(n == 1)),
+            tx, has_aux=True)
+    elif n == 1:
+        # fused head+CE: the [B, T, V] f32 logits never touch HBM
+        # (ops/fused_ce.py; +16% tok/s at gpt2-small on v5e)
+        step = build_gspmd_train_step(
+            lambda p, t: gpt_fused_loss(model, p, t), tx)
     else:
+        # any multi-chip layout (dp or tp) keeps the unfused head: the
+        # fused pallas_call has no GSPMD partitioning rule, so under
+        # pjit it would all-gather/replicate its operands per device
+        # and defeat the sharding this row exists to measure (a
+        # shard_map-wrapped fused variant is the known follow-up)
         step = build_gspmd_train_step(
             lambda p, t: gpt_loss(model.apply({"params": p}, t), t), tx)
 
@@ -101,6 +137,8 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
         "platform": platform, "devices": n, "tp": tp, "size": size,
         "per_data_batch": batch, "seq": seq, "attention": attention,
         "step_time_ms": round(dt * 1000, 2), "iters": iters,
+        "mfu_vs_v5e_bf16_peak": _train_mfu(
+            cfg, global_tokens / dt, platform, seq, n),
     }
     if experts:
         meta["num_experts"] = experts
@@ -178,6 +216,8 @@ def measure_pp_rate(size: str = "small", batch: int = 8, seq: int = 1024,
         "batch": batch, "seq": seq, "microbatches": microbatches,
         "schedule": "1F1B", "step_time_ms": round(dt * 1000, 2),
         "iters": iters,
+        "mfu_vs_v5e_bf16_peak": _train_mfu(
+            cfg, batch * seq / dt, platform, seq, pp),
     }
     return batch * seq / dt, meta
 

@@ -12,8 +12,8 @@ stay in exact parity with the architectures.
 
 from .bert import BertConfig, BertEncoder
 from .fake_models import fake_model_catalog, model_param_sizes
-from .gpt import (GPTConfig, GPTLM, gpt_generate, gpt_loss,
-                  gpt_loss_with_aux, gpt_pipeline_forward,
+from .gpt import (GPTConfig, GPTLM, gpt_fused_loss, gpt_generate,
+                  gpt_loss, gpt_loss_with_aux, gpt_pipeline_forward,
                   stack_gpt_blocks)
 from .inception import InceptionV3
 from .mlp import MLP, SLP
@@ -33,6 +33,7 @@ __all__ = [
     "BertEncoder",
     "GPTConfig",
     "GPTLM",
+    "gpt_fused_loss",
     "gpt_generate",
     "gpt_loss",
     "gpt_loss_with_aux",

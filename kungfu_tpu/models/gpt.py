@@ -284,7 +284,7 @@ class GPTLM(nn.Module):
 
     @nn.compact
     def __call__(self, token_ids, decode: bool = False,
-                 prefill: bool = False):
+                 prefill: bool = False, return_hidden: bool = False):
         c = self.config
         local_len = token_ids.shape[-1]
         if prefill:
@@ -336,6 +336,11 @@ class GPTLM(nn.Module):
         for _ in range(c.num_layers):
             x = Block(c)(x, decode=decode, prefill=prefill)
         x = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
+        if return_hidden:
+            # training fast path: the caller feeds these states to
+            # ops.fused_ce.chunked_cross_entropy with params["lm_head"],
+            # so the [B, T, vocab] f32 logits are never materialized
+            return x
         return nn.Dense(c.vocab_size, dtype=jnp.float32,
                         name="lm_head")(x)
 
@@ -353,7 +358,30 @@ def gpt_loss(logits, token_ids):
         logits[:, :-1].astype(jnp.float32), token_ids[:, 1:]).mean()
 
 
-def gpt_loss_with_aux(model: GPTLM, params, token_ids):
+def gpt_fused_loss(model: GPTLM, params, token_ids):
+    """`gpt_loss`, but through `ops.fused_ce.fused_cross_entropy`.
+
+    Runs the trunk with `return_hidden=True` and applies the lm_head
+    inside the fused Pallas kernel, so the [B, T, vocab] f32 logits are
+    never materialized in HBM and all three head matmuls (logits, dW,
+    dx) run bf16 with f32 accumulation. Same math as
+    ``gpt_loss(model.apply(...), tokens)`` up to bf16 rounding of the
+    head weights; use this for training, `gpt_loss` for eval paths
+    that want the raw logits.
+    """
+    from ..ops.fused_ce import fused_cross_entropy
+
+    hidden = model.apply({"params": params}, token_ids,
+                         return_hidden=True)
+    b, t, h = hidden.shape
+    return fused_cross_entropy(
+        hidden[:, :-1].reshape(b * (t - 1), h),
+        params["lm_head"]["kernel"], params["lm_head"]["bias"],
+        token_ids[:, 1:].reshape(-1))
+
+
+def gpt_loss_with_aux(model: GPTLM, params, token_ids,
+                      fused: bool = True):
     """(total_loss, metrics): cross entropy + the MoE router losses.
 
     Runs the model with the "losses" collection mutable, averages each
@@ -365,9 +393,27 @@ def gpt_loss_with_aux(model: GPTLM, params, token_ids):
     collapses onto few experts.
     """
     c = model.config
-    logits, mutated = model.apply({"params": params}, token_ids,
-                                  mutable=["losses"])
-    ce = gpt_loss(logits, token_ids)
+    if fused:
+        # fused head+CE (ops/fused_ce.py): bf16 head matmuls with f32
+        # accumulation, no [B, T, vocab] f32 logits. `fused=False`
+        # keeps the f32 Dense head — use it under GSPMD-sharded
+        # multi-chip meshes (the pallas_call has no partitioning rule
+        # and would replicate its operands) or when f32 head numerics
+        # are required.
+        from ..ops.fused_ce import fused_cross_entropy
+
+        hidden, mutated = model.apply({"params": params}, token_ids,
+                                      mutable=["losses"],
+                                      return_hidden=True)
+        b, t, h = hidden.shape
+        ce = fused_cross_entropy(
+            hidden[:, :-1].reshape(b * (t - 1), h),
+            params["lm_head"]["kernel"], params["lm_head"]["bias"],
+            token_ids[:, 1:].reshape(-1))
+    else:
+        logits, mutated = model.apply({"params": params}, token_ids,
+                                      mutable=["losses"])
+        ce = gpt_loss(logits, token_ids)
     metrics = {"ce": ce}
     total = ce
     if c.num_experts:
@@ -557,7 +603,6 @@ def gpt_pipeline_train_step(cfg: GPTConfig, outer, stage_blocks, tokens,
     pos_embed = nn.Embed(cfg.max_position, cfg.hidden_size,
                          dtype=cfg.dtype)
     ln = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32)
-    head = nn.Dense(cfg.vocab_size, dtype=jnp.float32)
 
     def enter_fn(op, mb_tokens):
         x = embed.apply({"params": op["wte"]}, mb_tokens)
@@ -572,9 +617,17 @@ def gpt_pipeline_train_step(cfg: GPTConfig, outer, stage_blocks, tokens,
         return h
 
     def exit_fn(op, h, mb_tokens):
+        from ..ops.fused_ce import fused_cross_entropy
+
         x = ln.apply({"params": op["LayerNorm_0"]}, h)
-        logits = head.apply({"params": op["lm_head"]}, x)
-        return gpt_loss(logits, mb_tokens)
+        mb, tt, hd = x.shape
+        # fused head+CE per microbatch: no [mb, T, vocab] f32 logits
+        # (configs whose hidden doesn't tile fall back to the dense
+        # head inside fused_cross_entropy's reference path)
+        return fused_cross_entropy(
+            x[:, :-1].reshape(mb * (tt - 1), hd),
+            op["lm_head"]["kernel"], op["lm_head"]["bias"],
+            mb_tokens[:, 1:].reshape(-1))
 
     loss, g_outer, g_stage = pipeline_train_step_1f1b(
         stage_fn, enter_fn, exit_fn,
