@@ -407,6 +407,30 @@ def run_adaptation(args):
     }
 
 
+def run_straggler(args):
+    """The reference's async-scalability claim, measured: one worker
+    sleeps 100 ms/step; barrier-free pair averaging must hold cluster
+    throughput while S-SGD tracks the straggler (reference:
+    README.md:207-209, benchmarks/system/result/async-scalability.svg)."""
+    from .straggler import measure
+
+    np_ = 8
+    ms = 100
+    res = measure(np_=np_, straggler_ms=ms, steps=40, batch=64,
+                  port_range="29100-29999")
+    return {
+        "config": (
+            f"{np_} kfrun worker processes, SLP on synthetic MNIST, "
+            f"batch 64/worker; one worker sleeps {ms} ms/step; cluster "
+            "throughput = sum of per-worker sample rates; retention = "
+            "straggler-run / clean-run throughput"
+        ),
+        "results": res,
+        "async_holds": res["pair"]["retention"] > 0.7,
+        "sync_tracks_straggler": res["sync"]["retention"] < 0.6,
+    }
+
+
 CONFIG_KEYS = {
     "mnist-slp": ("mnist_slp_syncsgd", run_mnist_slp),
     "pair-convergence": ("resnet50_pair_averaging_convergence_proxy",
@@ -415,6 +439,7 @@ CONFIG_KEYS = {
     "adaptation": ("elastic_adaptation_latency", run_adaptation),
     "digits-convergence": ("real_digits_convergence",
                            run_digits_convergence),
+    "straggler": ("async_straggler_scalability", run_straggler),
 }
 
 
