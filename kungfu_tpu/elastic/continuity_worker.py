@@ -1,0 +1,127 @@
+"""Elastic resize with LOSS CONTINUITY asserted (real training).
+
+An SLP trains under S-SGD while the schedule grows the cluster; on
+resize every worker re-syncs position and weights. The continuity
+checks make the state broadcast load-bearing:
+
+- a JOINER evaluates its first batch twice — with its fresh-init
+  weights and with the broadcast weights — and asserts the broadcast
+  model is strictly better (it adopted trained state, not an init);
+- a SURVIVOR asserts the first post-resize loss stays near its
+  pre-resize loss (no reset to init-level loss).
+
+Markers: CONTINUITY_MARKERS in `elastic.harness` — parsed by
+tests/test_elastic.py and the driver's
+`__graft_entry__.dryrun_multichip` elastic phase, both via
+`kungfu_tpu.elastic.harness.run_loss_continuity`.
+
+Run under kfrun as `python -m kungfu_tpu.elastic.continuity_worker`.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import kungfu_tpu
+from kungfu_tpu.data import ElasticSampler
+from kungfu_tpu.datasets import load_synthetic_split
+from kungfu_tpu.elastic import ElasticCallback
+from kungfu_tpu.initializer import broadcast_variables
+from kungfu_tpu.models import SLP
+from kungfu_tpu.ops.collective import defuse, fuse
+
+TOTAL_STEPS = int(os.environ.get("TEST_TOTAL_STEPS", "12"))
+SCHEDULE = os.environ.get("TEST_SCHEDULE", "6:2,6:4")
+BATCH = 64
+LR = 0.1
+
+peer = kungfu_tpu.init()
+ds = load_synthetic_split(n=2048, seed=0)
+x, y = ds.images, ds.labels
+model = SLP(num_classes=10)
+params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+tx = optax.sgd(LR)
+opt_state = tx.init(params)
+
+
+@jax.jit
+def loss_and_grads(params, batch):
+    def loss_fn(p):
+        logits = model.apply({"params": p}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    return jax.value_and_grad(loss_fn)(params)
+
+
+elastic = ElasticCallback(peer, schedule=SCHEDULE,
+                          samples_per_step=BATCH)
+
+
+def make_sampler():
+    return ElasticSampler(len(x), BATCH, peer.rank, peer.size, seed=1,
+                          offset=elastic.state.trained_samples)
+
+
+if peer.config.version > 0:
+    # joiner: adopt position + weights, then PROVE the weights are
+    # trained state by comparing against this process's fresh init
+    elastic.sync_position()
+    fresh = params
+    params = broadcast_variables(params, peer=peer)
+    sampler = make_sampler()
+    idx = sampler.next_indices()
+    batch = {"x": x[idx], "y": y[idx]}
+    fresh_loss = float(loss_and_grads(fresh, batch)[0])
+    got_loss = float(loss_and_grads(params, batch)[0])
+    print(f"KF_JOINER_CONTINUITY rank={peer.rank} "
+          f"fresh={fresh_loss:.4f} broadcast={got_loss:.4f}", flush=True)
+    assert got_loss < fresh_loss - 0.05, (
+        f"joiner's broadcast weights are no better than a fresh init "
+        f"({got_loss:.4f} vs {fresh_loss:.4f}): state broadcast failed")
+else:
+    sampler = make_sampler()
+
+last_loss = None
+pending_continuity = None  # survivor's pre-resize loss
+while elastic.state.step < TOTAL_STEPS:
+    idx = sampler.next_indices()
+    batch = {"x": x[idx], "y": y[idx]}
+    loss, grads = loss_and_grads(params, batch)
+    loss = float(loss)
+    buf = peer.all_reduce(np.asarray(fuse(grads)),
+                          name=f"g:{peer.version}:{elastic.state.step}")
+    grads = defuse(jnp.asarray(buf) / peer.size, grads)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+
+    if pending_continuity is not None:
+        print(f"KF_SURVIVOR_CONTINUITY rank={peer.rank} "
+              f"pre={pending_continuity:.4f} post={loss:.4f}",
+              flush=True)
+        assert loss < pending_continuity + 0.5, (
+            f"post-resize loss {loss:.4f} jumped from "
+            f"{pending_continuity:.4f}: training state was lost")
+        pending_continuity = None
+    last_loss = loss
+
+    if elastic.after_step():
+        if not elastic.state.keep:
+            print(f"evicted at step {elastic.state.step}", flush=True)
+            raise SystemExit(0)
+        elastic.sync_position()
+        params = broadcast_variables(params, peer=peer)
+        sampler = make_sampler()
+        pending_continuity = last_loss
+        print(f"resized: epoch {peer.version} size={peer.size} "
+              f"step={elastic.state.step}", flush=True)
+
+print(f"KF_CONTINUITY_DONE rank={peer.rank} size={peer.size} "
+      f"step={elastic.state.step} loss={last_loss:.4f}", flush=True)

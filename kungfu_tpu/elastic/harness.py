@@ -1,0 +1,106 @@
+"""Drive a real elastic resize end-to-end and assert loss continuity.
+
+One shared entry point for every consumer that wants the full
+config-server + kfrun-watcher + consensus + state-broadcast loop
+exercised with REAL training (tests/test_elastic.py and the driver's
+`__graft_entry__.dryrun_multichip` elastic phase): boots a config
+server, launches `kungfu_tpu.elastic.continuity_worker` under a
+watch-mode runner, and asserts the worker-side continuity markers.
+
+Reference analog: scripts/tests/run-elastic-test.sh drives
+kungfu-fake-adaptive-trainer the same way (boot server, walk schedule,
+grep worker logs) — here the trainer is real and the grep asserts
+state, not just liveness.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+CONTINUITY_MARKERS = (
+    # marker -> what its absence means
+    ("KF_JOINER_CONTINUITY", "joiner state broadcast unproven"),
+    ("KF_SURVIVOR_CONTINUITY", "survivor loss continuity unproven"),
+    ("KF_CONTINUITY_DONE", "schedule did not complete"),
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def ensure_libkf() -> None:
+    """Build the native DCN runtime if this checkout hasn't yet."""
+    native = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    if os.path.exists(os.path.join(native, "libkf.so")):
+        return
+    r = subprocess.run(["make", "-C", native], capture_output=True,
+                       text=True)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"libkf.so build failed rc={r.returncode}:\n"
+            f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+
+
+def run_loss_continuity(schedule: str = "6:2,6:4",
+                        total_steps: int = 12,
+                        start_np: int = 2,
+                        slots: int = 4,
+                        port_range: str = "27100-27999",
+                        timeout: int = 600,
+                        logdir: str | None = None) -> str:
+    """Run the continuity trainer through a live resize; returns the
+    combined worker logs. Raises AssertionError (with the logs) if the
+    cluster fails or any continuity marker is missing — the worker
+    itself asserts the actual loss relations and exits nonzero on
+    violation, so a green return means the state broadcast carried
+    trained weights through the resize."""
+    ensure_libkf()
+    from .config_server import ConfigServer
+
+    server = ConfigServer(port=0).start()
+    own_logdir = logdir is None
+    tmp = tempfile.TemporaryDirectory() if own_logdir else None
+    logdir = tmp.name if own_logdir else logdir
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["KF_TIMEOUT_MS"] = env.get("KF_TIMEOUT_MS", "120000")
+        env["KF_LOG_LEVEL"] = "warn"
+        env["PALLAS_AXON_POOL_IPS"] = ""  # control-plane-only workers
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TEST_SCHEDULE"] = schedule
+        env["TEST_TOTAL_STEPS"] = str(total_steps)
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.run",
+             "-np", str(start_np), "-H", f"127.0.0.1:{slots}",
+             "-port-range", port_range,
+             "-w", "-config-server", server.get_url,
+             "-logdir", logdir, "-q",
+             "--", sys.executable, "-m",
+             "kungfu_tpu.elastic.continuity_worker"],
+            cwd=_REPO, env=env, timeout=timeout, capture_output=True,
+            text=True)
+        logs = ""
+        for f in sorted(os.listdir(logdir)):
+            if f.endswith(".log"):
+                with open(os.path.join(logdir, f)) as fh:
+                    logs += f"--- {f} ---\n" + fh.read()
+        if r.returncode != 0:
+            raise AssertionError(
+                f"elastic continuity run failed rc={r.returncode}:\n"
+                f"stdout: {r.stdout[-2000:]}\n"
+                f"stderr: {r.stderr[-2000:]}\n{logs[-2000:]}")
+        for marker, why in CONTINUITY_MARKERS:
+            if marker not in logs:
+                raise AssertionError(
+                    f"elastic continuity: {why} ({marker} missing):\n"
+                    f"{logs[-2000:]}")
+        return logs
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+        server.stop()

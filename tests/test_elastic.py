@@ -50,3 +50,18 @@ def test_elastic_schedule_resize(tmp_path):
         assert "finished rank=0 size=1 step=8" in logs, logs
     finally:
         server.stop()
+
+
+def test_elastic_resize_loss_continuity(tmp_path):
+    """2 -> 4 growth during REAL training: joiners must adopt trained
+    weights (not fresh inits) and survivors' loss must not jump — the
+    state-broadcast path made load-bearing. Shares the harness with
+    the driver's `__graft_entry__.dryrun_multichip` elastic phase."""
+    from kungfu_tpu.elastic.harness import run_loss_continuity
+
+    logs = run_loss_continuity(port_range="29000-29999",
+                               logdir=str(tmp_path), timeout=300)
+    # both joiners proved broadcast weights beat their fresh init
+    assert logs.count("KF_JOINER_CONTINUITY") >= 2, logs
+    # the cluster finished the schedule at size 4
+    assert "size=4 step=12" in logs, logs
