@@ -382,6 +382,11 @@ def _local_attention(q, k, v, causal=False, scale=None, window=None):
     [q - window, q]. The single reference implementation for the flash
     kernel and the sequence-parallel mixers.
     """
+    if window is not None and not causal:
+        # same contract as ops.flash.flash_attention: a silent causal
+        # mask here would let the two "reference implementations" of
+        # one op diverge for the same input
+        raise ValueError("window requires causal=True")
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)

@@ -177,9 +177,26 @@ def _is_python_prog(prog: List[str]) -> bool:
     `python -m mod ...` or `python script.py ...`. Interpreter flags
     (`python -u x.py`) are rejected — runpy can't honor them, and a
     wrongly-warmed slot would crash at activation and fail the whole
-    cluster fast."""
-    base = os.path.basename(prog[0]) if prog else ""
-    if not (prog[:1] == [sys.executable] or base.startswith("python")):
+    cluster fast. The interpreter must resolve to THIS runner's
+    `sys.executable`: warm slots are spawned with it, so accepting any
+    'python*' basename would warm-activate a job meant for a different
+    interpreter (e.g. a venv's) under the wrong one."""
+    if not prog:
+        return False
+    import shutil
+
+    exe = shutil.which(prog[0]) or prog[0]
+    try:
+        # same interpreter file AND same bin directory: venvs symlink
+        # bin/python to one base interpreter, so a realpath match alone
+        # would accept a *different* venv's python (whose site-packages
+        # the warm slot does not have)
+        if (os.path.realpath(exe)
+                != os.path.realpath(sys.executable)
+                or os.path.realpath(os.path.dirname(os.path.abspath(exe)))
+                != os.path.realpath(os.path.dirname(sys.executable))):
+            return False
+    except OSError:
         return False
     tail = prog[1:]
     if not tail:
@@ -288,6 +305,14 @@ class WarmPool:
                 return self.take()
         return self._warm.pop(0) if self._warm else None  # still importing
 
+    def mark_activation_ok(self):
+        """A successful activation proves the pool healthy — also for
+        slots popped on take()'s still-importing path, which bypasses
+        the marker-read reset. Without this, scattered pre-activation
+        deaths over a long run would permanently disable the pool
+        despite healthy activations in between."""
+        self._failures = 0
+
     def shutdown(self):
         for p in self._warm:
             try:
@@ -342,6 +367,7 @@ def activate_warm(
     except Exception:
         popen.kill()
         return None
+    pool.mark_activation_ok()
     popen, pump = _attach_pump(popen, rank, log_path, quiet)
     return Proc(
         peer=self_id,
