@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Build docs/*.md into a browsable HTML site (build/docs/).
+
+The reference CI's final step builds its Sphinx docs (reference:
+.github/workflows/ci.yaml, docs/); this is the dependency-light
+equivalent for this repo: python-markdown (baked into the image) plus
+a strict check pass — every intra-docs link must resolve and every
+docs page must be reachable from index.md — so documentation rot fails
+the build the same way a Sphinx warning-as-error would.
+
+  python scripts/build-docs.py [--out build/docs]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+TEMPLATE = """<!doctype html>
+<html><head><meta charset="utf-8">
+<title>{title} — kungfu_tpu</title>
+<style>
+ body {{ max-width: 54rem; margin: 2rem auto; padding: 0 1rem;
+        font: 16px/1.6 system-ui, sans-serif; color: #1a1a1a; }}
+ pre {{ background: #f6f8fa; padding: .8rem; overflow-x: auto; }}
+ code {{ background: #f6f8fa; padding: .1rem .25rem; }}
+ pre code {{ padding: 0; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #ccc; padding: .3rem .6rem; }}
+ nav {{ border-bottom: 1px solid #ddd; margin-bottom: 1.5rem;
+       padding-bottom: .5rem; }}
+ nav a {{ margin-right: 1rem; }}
+</style></head><body>
+<nav>{nav}</nav>
+{body}
+</body></html>
+"""
+
+
+def build(docs_dir: str, out_dir: str) -> int:
+    import markdown
+
+    pages = sorted(f for f in os.listdir(docs_dir) if f.endswith(".md"))
+    if "index.md" not in pages:
+        print("docs/index.md missing", file=sys.stderr)
+        return 1
+    os.makedirs(out_dir, exist_ok=True)
+    nav = " ".join(
+        f'<a href="{p[:-3]}.html">{p[:-3]}</a>' for p in pages)
+    errors = []
+    links = {}  # page -> set of intra-docs pages it links to
+    for page in pages:
+        src = open(os.path.join(docs_dir, page)).read()
+        links[page] = set()
+        # strict link check: every relative .md link must exist
+        for target in re.findall(r"\]\(([^)#]+\.md)(?:#[^)]*)?\)", src):
+            if target.startswith(("http://", "https://")):
+                continue
+            resolved = os.path.normpath(
+                os.path.join(docs_dir, os.path.dirname(page), target))
+            if not os.path.exists(resolved):
+                errors.append(f"{page}: broken link -> {target}")
+            else:
+                links[page].add(os.path.basename(resolved))
+        html = markdown.markdown(
+            src, extensions=["tables", "fenced_code"])
+        # rewrite intra-docs links to the generated pages (external
+        # URLs that happen to end in .md must keep their extension)
+        html = re.sub(r'href="([^"#]+)\.md(#[^"]*)?"',
+                      lambda m: m.group(0)
+                      if m.group(1).startswith(("http://", "https://"))
+                      else f'href="{m.group(1)}.html{m.group(2) or ""}"',
+                      html)
+        title = page[:-3]
+        m = re.search(r"<h1[^>]*>(.*?)</h1>", html)
+        if m:
+            title = re.sub(r"<[^>]+>", "", m.group(1))
+        with open(os.path.join(out_dir, page[:-3] + ".html"), "w") as f:
+            f.write(TEMPLATE.format(title=title, nav=nav, body=html))
+    # every page must be REACHABLE from index.md (BFS over the link
+    # graph: a pair of pages linking only each other is still orphaned)
+    reachable = {"index.md"}
+    frontier = ["index.md"]
+    while frontier:
+        nxt = links.get(frontier.pop(), set()) - reachable
+        reachable |= nxt
+        frontier.extend(nxt)
+    for page in pages:
+        if page not in reachable:
+            errors.append(
+                f"{page}: orphaned (not reachable from index.md)")
+    if errors:
+        print("\n".join(errors), file=sys.stderr)
+        return 1
+    print(f"built {len(pages)} pages -> {out_dir}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs"))
+    ap.add_argument("--out", default="build/docs")
+    args = ap.parse_args()
+    return build(args.docs, args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,12 +13,12 @@ cd "$(dirname "$0")/.."
 QUICK=0
 [ "${1:-}" = "--quick" ] && QUICK=1
 
-echo "== [1/4] native build + C++ smoke =="
+echo "== [1/5] native build + C++ smoke =="
 make -C kungfu_tpu/native -j"$(nproc)"
 make -C kungfu_tpu/native test
 
 if [ "$QUICK" = 0 ]; then
-  echo "== [2/4] pytest suite =="
+  echo "== [2/5] pytest suite =="
   # per-test timeouts need pytest-timeout (CI installs it); locally the
   # suite runs without it rather than failing on the missing plugin
   if python -c "import pytest_timeout" 2>/dev/null; then
@@ -27,10 +27,10 @@ if [ "$QUICK" = 0 ]; then
     timeout 2700 python -m pytest tests/ -q
   fi
 else
-  echo "== [2/4] pytest suite skipped (--quick) =="
+  echo "== [2/5] pytest suite skipped (--quick) =="
 fi
 
-echo "== [3/4] integration sweep: np x strategy =="
+echo "== [3/5] integration sweep: np x strategy =="
 # the reference sweeps np=1..4 x all strategies with a per-run timeout
 # (run-integration-tests.sh:18-40); same sweep, same fake trainer idea
 export JAX_PLATFORMS=cpu
@@ -48,9 +48,17 @@ for np in 1 2 3 4; do
   done
 done
 
-echo "== [4/4] examples smoke =="
+echo "== [4/5] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \
   --schedule 3:2,3:3 --steps 6
+
+if [ "$QUICK" = 0 ]; then
+  echo "== [5/5] docs build =="
+  python scripts/build-docs.py
+else
+  # CI runs --quick and builds the docs in its own named step
+  echo "== [5/5] docs build skipped (--quick) =="
+fi
 
 echo "ALL GREEN"
