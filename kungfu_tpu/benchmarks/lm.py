@@ -176,10 +176,13 @@ def measure_pp_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     if pp > n:
         raise SystemExit(f"--pp {pp} exceeds device count {n}")
     hidden, layers, heads, inter = SIZES[size]
+    # flash mixer inside the pipeline stages too (same kernel as the
+    # dense rows; tiny CPU smoke shapes fall back to plain attention)
     cfg = GPTConfig(vocab_size=50257, hidden_size=hidden,
                     num_layers=layers, num_heads=heads,
                     intermediate_size=inter,
-                    max_position=max(1024, seq), dtype=jnp.bfloat16)
+                    max_position=max(1024, seq), dtype=jnp.bfloat16,
+                    attention="flash" if platform != "cpu" else "local")
     model = GPTLM(cfg)
     tokens = jnp.zeros((batch, seq), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]

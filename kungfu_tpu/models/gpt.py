@@ -358,7 +358,8 @@ def gpt_loss(logits, token_ids):
         logits[:, :-1].astype(jnp.float32), token_ids[:, 1:]).mean()
 
 
-def gpt_fused_loss(model: GPTLM, params, token_ids):
+def gpt_fused_loss(model: GPTLM, params, token_ids,
+                   interpret: bool | None = None):
     """`gpt_loss`, but through `ops.fused_ce.fused_cross_entropy`.
 
     Runs the trunk with `return_hidden=True` and applies the lm_head
@@ -368,6 +369,11 @@ def gpt_fused_loss(model: GPTLM, params, token_ids):
     ``gpt_loss(model.apply(...), tokens)`` up to bf16 rounding of the
     head weights; use this for training, `gpt_loss` for eval paths
     that want the raw logits.
+
+    `interpret=None` auto-selects Pallas interpreter mode off-TPU from
+    the DEFAULT backend; pass `interpret=True` explicitly when the
+    step is jitted onto CPU devices while a TPU owns the default
+    backend (the driver's dryrun environment).
     """
     from ..ops.fused_ce import fused_cross_entropy
 
@@ -377,7 +383,7 @@ def gpt_fused_loss(model: GPTLM, params, token_ids):
     return fused_cross_entropy(
         hidden[:, :-1].reshape(b * (t - 1), h),
         params["lm_head"]["kernel"], params["lm_head"]["bias"],
-        token_ids[:, 1:].reshape(-1))
+        token_ids[:, 1:].reshape(-1), interpret=interpret)
 
 
 def gpt_loss_with_aux(model: GPTLM, params, token_ids,
