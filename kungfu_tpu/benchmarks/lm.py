@@ -101,22 +101,30 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     tx = optax.adamw(1e-4)
     opt = tx.init(params)
     if experts:
-        # fused head only single-chip, same rationale as the dense
-        # branch below
+        # fused head single-chip only: the Switch expert stacks are
+        # GSPMD-sharded over the "model" axis, which the pure-dp
+        # shard_map builder cannot express, so multi-chip MoE stays on
+        # the annotation-sharded path with the unfused head
         step = build_gspmd_train_step(
             lambda p, t: gpt_loss_with_aux(model, p, t, fused=(n == 1)),
             tx, has_aux=True)
     elif n == 1:
         # fused head+CE: the [B, T, V] f32 logits never touch HBM
-        # (ops/fused_ce.py; +16% tok/s at gpt2-small on v5e)
+        # (ops/fused_ce.py; +20% tok/s at gpt2-small on v5e)
         step = build_gspmd_train_step(
             lambda p, t: gpt_fused_loss(model, p, t), tx)
+    elif tp == 1:
+        # multi-chip dp: shard_map keeps the fused Pallas kernel inside
+        # the per-shard region (the GSPMD partitioner has no rule for
+        # pallas_call and would all-gather its operands)
+        from kungfu_tpu.parallel import build_dp_replicated_train_step
+
+        step = build_dp_replicated_train_step(
+            lambda p, t: gpt_fused_loss(model, p, t), tx, mesh)
     else:
-        # any multi-chip layout (dp or tp) keeps the unfused head: the
-        # fused pallas_call has no GSPMD partitioning rule, so under
-        # pjit it would all-gather/replicate its operands per device
-        # and defeat the sharding this row exists to measure (a
-        # shard_map-wrapped fused variant is the known follow-up)
+        # tp > 1 keeps the unfused head: the vocab-replicated lm_head
+        # runs under GSPMD with the Megatron-sharded trunk this row
+        # exists to measure
         step = build_gspmd_train_step(
             lambda p, t: gpt_loss(model.apply({"params": p}, t), t), tx)
 

@@ -28,8 +28,9 @@ from .pipeline import (pipeline_apply, pipeline_train_step_1f1b,
                        stack_stage_params)
 from .tensor import (bert_tp_rules, gpt_moe_rules, gpt_tp_rules,
                      shard_params)
-from .train import (build_eval_step, build_gspmd_train_step,
-                    build_train_step, build_train_step_with_state)
+from .train import (build_dp_replicated_train_step, build_eval_step,
+                    build_gspmd_train_step, build_train_step,
+                    build_train_step_with_state)
 from .zero import zero1_shard_opt_state
 
 __all__ = [
@@ -45,6 +46,7 @@ __all__ = [
     "build_eval_step",
     "build_train_step_with_state",
     "build_gspmd_train_step",
+    "build_dp_replicated_train_step",
     "init_distributed",
     "shutdown_distributed",
     "dispatch_tensors",

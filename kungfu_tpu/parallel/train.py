@@ -144,6 +144,53 @@ def build_gspmd_train_step(
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
+def build_dp_replicated_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = "data",
+    donate: bool = True,
+):
+    """Data-parallel train step for REPLICATED params with a per-shard
+    loss — the home for Pallas-fused losses under dp.
+
+    `build_gspmd_train_step` covers annotation-sharded layouts, but
+    `pallas_call` has no GSPMD partitioning rule: under a multi-device
+    mesh the partitioner replicates a fused kernel's operands (an
+    all-gather of the full-batch activations) instead of running it on
+    each data shard. This builder closes that gap with shard_map:
+    every device evaluates `loss_fn(params, batch_shard)` — e.g.
+    ``lambda p, t: gpt_fused_loss(model, p, t)`` — on its shard,
+    grads and loss are pmean'd over `axis_name`, and the (replicated)
+    optimizer update follows: the standard dp recipe with the kernel
+    inside the per-shard region where it belongs.
+
+    `params`/`opt_state` replicated, the batch sharded over
+    `axis_name` with equal shard sizes (so the mean-of-shard-means
+    equals the global mean). Returns
+    `step(params, opt_state, batch) -> (params, opt_state, loss)` —
+    the same signature as `build_gspmd_train_step`'s dense form.
+    """
+
+    def device_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, axis_name), grads)
+        loss = lax.pmean(loss, axis_name)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    mapped = shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+
 def build_eval_step(
     metric_fn: Callable, mesh: Mesh, axis_name: str = "data"
 ):
