@@ -78,6 +78,14 @@ def _pick_blocks(n, h, v):
     to the reference path instead of hitting a Mosaic compile OOM."""
     bn = min(_BLOCK_N, _round_up(n, 16))
     bv = min(_BLOCK_V, _round_up(v, 128))
+    if n > 8192 and bv > 512:
+        # empirical (v5e): the SAME (1024, 1024) blocks that compile
+        # and are fastest at n<=8192 hit Mosaic's scoped-vmem limit
+        # inside large full-model graphs at n=16384 (18.72 MB real vs
+        # a 14.7 MB estimate) — Mosaic's scheduling headroom shrinks
+        # with grid extent. bv=512 is verified there and costs <1%
+        # at the sizes that fit either way.
+        bv = 512
     while _fwd_vmem_bytes(bn, h, bv) > _VMEM_BUDGET:
         if bv > 512:
             bv //= 2
