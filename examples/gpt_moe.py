@@ -52,8 +52,13 @@ def main():
 
     tx = optax.adam(1e-2)
     opt = tx.init(params)
+    # fused head only when the mesh is a single device: the Pallas
+    # pallas_call has no GSPMD partitioning rule, so on a model-sharded
+    # multi-device mesh the partitioner would all-gather the full-batch
+    # activations into every chip (see gpt_loss_with_aux's docstring)
     step = build_gspmd_train_step(
-        lambda p, t: gpt_loss_with_aux(model, p, t), tx, has_aux=True)
+        lambda p, t: gpt_loss_with_aux(model, p, t, fused=(n == 1)),
+        tx, has_aux=True)
 
     for i in range(60):
         params, opt, loss, m = step(params, opt, tokens)

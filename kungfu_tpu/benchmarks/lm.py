@@ -25,12 +25,19 @@ SIZES = {
     "medium": (1024, 24, 16, 4096),  # GPT-2 350M
 }
 
-_V5E_BF16_PEAK = 197e12  # TPU v5e peak bf16 FLOP/s (per chip)
+# Peak bf16 FLOP/s per chip, keyed by jax device_kind. MFU is only
+# reported for kinds listed here — a hard-coded peak on an unknown
+# accelerator would print a wrong-by-construction number.
+_BF16_PEAK_BY_KIND = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,      # alternate kind string some stacks report
+}
 
 
-def _train_mfu(cfg, tokens_per_sec, platform, seq, n_chips):
-    """Model FLOPs utilization of a train step vs the v5e bf16 peak
-    across `n_chips` chips.
+def _train_mfu(cfg, tokens_per_sec, seq, n_chips):
+    """Model FLOPs utilization of a train step vs the chip's bf16 peak
+    across `n_chips` chips; None when the peak for this device kind is
+    unknown (CPU, or a TPU generation not in `_BF16_PEAK_BY_KIND`).
 
     Standard accounting (PaLM appendix B): 6 FLOPs per ACTIVE matmul
     parameter per token (fwd+bwd) — attention projections, the MLP (one
@@ -38,7 +45,11 @@ def _train_mfu(cfg, tokens_per_sec, platform, seq, n_chips):
     exist), the lm_head — plus the causal attention term
     6 * L * h * T per token. Embedding lookups are not matmuls and are
     not counted."""
-    if platform == "cpu":
+    import jax
+
+    peak_per_chip = _BF16_PEAK_BY_KIND.get(
+        jax.devices()[0].device_kind)
+    if peak_per_chip is None:
         return None
     h, inter = cfg.hidden_size, cfg.intermediate_size
     per_layer = 4 * h * h + 2 * h * inter  # qkvo + one expert's MLP
@@ -46,7 +57,7 @@ def _train_mfu(cfg, tokens_per_sec, platform, seq, n_chips):
         per_layer += h * cfg.num_experts   # router projection
     n_mat = cfg.num_layers * per_layer + h * cfg.vocab_size
     flops_per_tok = 6 * n_mat + 6 * cfg.num_layers * h * seq
-    peak = _V5E_BF16_PEAK * max(n_chips, 1)
+    peak = peak_per_chip * max(n_chips, 1)
     return round(tokens_per_sec * flops_per_tok / peak, 4)
 
 
@@ -146,7 +157,7 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
         "per_data_batch": batch, "seq": seq, "attention": attention,
         "step_time_ms": round(dt * 1000, 2), "iters": iters,
         "mfu_vs_v5e_bf16_peak": _train_mfu(
-            cfg, global_tokens / dt, platform, seq, n),
+            cfg, global_tokens / dt, seq, n),
     }
     if experts:
         meta["num_experts"] = experts
@@ -228,7 +239,7 @@ def measure_pp_rate(size: str = "small", batch: int = 8, seq: int = 1024,
         "schedule": "1F1B", "step_time_ms": round(dt * 1000, 2),
         "iters": iters,
         "mfu_vs_v5e_bf16_peak": _train_mfu(
-            cfg, batch * seq / dt, platform, seq, pp),
+            cfg, batch * seq / dt, seq, pp),
     }
     return batch * seq / dt, meta
 

@@ -338,7 +338,7 @@ class GPTLM(nn.Module):
         x = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         if return_hidden:
             # training fast path: the caller feeds these states to
-            # ops.fused_ce.chunked_cross_entropy with params["lm_head"],
+            # ops.fused_ce.fused_cross_entropy with params["lm_head"],
             # so the [B, T, vocab] f32 logits are never materialized
             return x
         return nn.Dense(c.vocab_size, dtype=jnp.float32,
@@ -387,7 +387,8 @@ def gpt_fused_loss(model: GPTLM, params, token_ids,
 
 
 def gpt_loss_with_aux(model: GPTLM, params, token_ids,
-                      fused: bool = True):
+                      fused: bool = True,
+                      interpret: bool | None = None):
     """(total_loss, metrics): cross entropy + the MoE router losses.
 
     Runs the model with the "losses" collection mutable, averages each
@@ -397,6 +398,12 @@ def gpt_loss_with_aux(model: GPTLM, params, token_ids,
     configs (num_experts=0) this reduces to `gpt_loss`. Use this — not
     bare `gpt_loss` — when training an MoE config, or the router
     collapses onto few experts.
+
+    `interpret` is forwarded to `fused_cross_entropy` (fused=True only):
+    None auto-selects Pallas interpreter mode off the default backend;
+    pass True explicitly when jitting onto CPU devices while a TPU owns
+    the default backend (the driver's dryrun environment), mirroring
+    `gpt_fused_loss`.
     """
     c = model.config
     if fused:
@@ -415,7 +422,7 @@ def gpt_loss_with_aux(model: GPTLM, params, token_ids,
         ce = fused_cross_entropy(
             hidden[:, :-1].reshape(b * (t - 1), h),
             params["lm_head"]["kernel"], params["lm_head"]["bias"],
-            token_ids[:, 1:].reshape(-1))
+            token_ids[:, 1:].reshape(-1), interpret=interpret)
     else:
         logits, mutated = model.apply({"params": params}, token_ids,
                                       mutable=["losses"])
