@@ -63,7 +63,8 @@ def _train_mfu(cfg, tokens_per_sec, seq, n_chips):
 
 def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
                     tp: int = 1, attention: str = "local",
-                    iters: int = 10, warmup: int = 2, experts: int = 0):
+                    iters: int = 10, warmup: int = 2, experts: int = 0,
+                    moe_group: int = 0, moe_bf16: bool = False):
     """Tokens/sec of LM training. Returns (tokens_per_sec, meta).
 
     `experts` > 0 swaps the dense FFN for the Switch MoE (global expert
@@ -97,7 +98,9 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
                     num_layers=layers, num_heads=heads,
                     intermediate_size=inter,
                     max_position=max(1024, seq), dtype=jnp.bfloat16,
-                    attention=attention, num_experts=experts)
+                    attention=attention, num_experts=experts,
+                    moe_group_size=moe_group,
+                    moe_param_dtype=jnp.bfloat16 if moe_bf16 else None)
     model = GPTLM(cfg)
 
     d_data = n // tp
@@ -109,8 +112,20 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     params = shard_params(jax.device_get(params), mesh, rules)
     tokens = jax.device_put(tokens, NamedSharding(mesh, P("data")))
 
-    tx = optax.adamw(1e-4)
-    opt = tx.init(params)
+    # bf16 expert storage: upcast gradients to f32 BEFORE adam so both
+    # moments stay f32 (optax moments follow the update dtype; a bf16
+    # nu freezes once 0.001*g^2 rounds below bf16's 8 mantissa bits).
+    # optax.apply_updates casts the final update back to each param's
+    # dtype, so the params themselves stay bf16.
+    upcast = optax.stateless(
+        lambda updates, _: jax.tree_util.tree_map(
+            lambda u: u.astype(jnp.float32), updates))
+    tx = optax.chain(upcast, optax.adamw(1e-4))
+    # init the moments from f32-cast shapes: zeros_like(bf16 params)
+    # would give bf16 mu/nu avals that flip to f32 after the first
+    # (upcast) update and force a retrace inside the timed loop
+    opt = tx.init(jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32), params))
     if experts:
         # fused head single-chip only: the Switch expert stacks are
         # GSPMD-sharded over the "model" axis, which the pure-dp
@@ -156,12 +171,21 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
         "platform": platform, "devices": n, "tp": tp, "size": size,
         "per_data_batch": batch, "seq": seq, "attention": attention,
         "step_time_ms": round(dt * 1000, 2), "iters": iters,
+        # key name is historical; the denominator is the peak for
+        # device_kind below (non-v5e kinds report None until listed)
         "mfu_vs_v5e_bf16_peak": _train_mfu(
             cfg, global_tokens / dt, seq, n),
+        "device_kind": jax.devices()[0].device_kind,
     }
     if experts:
+        from kungfu_tpu.models.gpt import effective_moe_group
+
         meta["num_experts"] = experts
+        # the EFFECTIVE group MoEMLP runs, not the requested one
+        meta["moe_group_size"] = effective_moe_group(
+            cfg, batch * d_data, seq)
         meta["loss_includes_router_aux"] = True
+        meta["moe_param_dtype"] = "bfloat16" if moe_bf16 else "float32"
     return global_tokens / dt, meta
 
 
@@ -324,6 +348,11 @@ def main():
     ap.add_argument("--experts", type=int, default=0,
                     help="Switch-MoE FFN with this many experts "
                          "(trains via gpt_loss_with_aux)")
+    ap.add_argument("--moe-group", type=int, default=0,
+                    help="(--experts) routing group size, 0 = auto 512")
+    ap.add_argument("--moe-bf16", action="store_true",
+                    help="(--experts) store expert stacks in bfloat16 "
+                         "instead of f32 master weights")
     ap.add_argument("--pp", type=int, default=0,
                     help="1F1B pipeline over this many stages")
     ap.add_argument("--microbatches", type=int, default=8,
@@ -358,7 +387,9 @@ def main():
         return
     rate, meta = measure_lm_rate(args.size, args.batch, args.seq,
                                  args.tp, args.attention, args.iters,
-                                 experts=args.experts)
+                                 experts=args.experts,
+                                 moe_group=args.moe_group,
+                                 moe_bf16=args.moe_bf16)
     print(json.dumps({"metric": "gpt_tokens_per_sec",
                       "value": round(rate, 1), "unit": "tokens/sec",
                       "details": meta}))

@@ -72,6 +72,17 @@ class GPTConfig:
     # (512, shrunk to fit); groups that don't divide B*T fall back to
     # one group per batch row.
     moe_group_size: int = 0
+    # storage dtype of the expert stacks (w_up/w_down). None keeps f32
+    # master weights and casts to `dtype` in apply — the safe default.
+    # bfloat16 stores them in compute precision: at 8 experts/layer the
+    # f32 stacks are 8x the dense FFN's, and the per-step f32 read
+    # (+ cast) is pure HBM traffic the MXU never needed. NOTE: optax
+    # moments follow the UPDATE dtype, so bf16 grads give bf16 mu AND
+    # nu, and a bf16 nu freezes once 0.001*g^2 rounds below bf16's 8
+    # mantissa bits — upcast gradients to f32 before adam (see
+    # benchmarks/lm.py) to keep both moments f32 while params stay
+    # bf16.
+    moe_param_dtype: Any = None
 
     def __post_init__(self):
         if self.attention not in _ATTN_MODES:
@@ -191,6 +202,17 @@ class CausalSelfAttention(nn.Module):
                                dtype=c.dtype, name="out")(out)
 
 
+def effective_moe_group(cfg: GPTConfig, b: int, t: int) -> int:
+    """The routing group size `MoEMLP` actually runs for a [b, t]
+    batch: the configured size (auto 512) clamped to b*t, falling back
+    to one group per batch row when it doesn't divide b*t. Benchmarks
+    report this, not the requested size."""
+    group = min(cfg.moe_group_size or 512, b * t)
+    if (b * t) % group:
+        group = t
+    return group
+
+
 class MoEMLP(nn.Module):
     """Switch top-1 MoE feed-forward in the einsum dispatch formulation.
 
@@ -214,20 +236,19 @@ class MoEMLP(nn.Module):
         router = self.param(
             "router", nn.initializers.normal(h ** -0.5), (h, e),
             jnp.float32)
+        pdt = c.moe_param_dtype or jnp.float32
         w_up = self.param(
             "w_up", nn.initializers.normal(h ** -0.5), (e, h, f),
-            jnp.float32).astype(c.dtype)
+            pdt).astype(c.dtype)
         w_down = self.param(
             "w_down", nn.initializers.normal(f ** -0.5), (e, f, h),
-            jnp.float32).astype(c.dtype)
+            pdt).astype(c.dtype)
         # GShard-style grouped routing: dispatch/combine are
         # [G, E, C, group] with C = ceil(group*cf/E), so total entries
         # are ~cf * group per token — linear in B*T, bounded by the
         # group size — instead of the quadratic [E, ceil(B*T*cf/E), B*T]
         # a single global group would cost.
-        group = min(c.moe_group_size or 512, b * t)
-        if (b * t) % group:
-            group = t  # per-row groups always divide
+        group = effective_moe_group(c, b, t)
         n_groups = (b * t) // group
         tokens = x.reshape(n_groups, group, h)
         capacity = moe_capacity(group, c.moe_capacity_factor, e)

@@ -8,20 +8,28 @@ op computes
 
     mean_i( logsumexp_v(x_i . W_v + b_v) - (x_i . W_t_i + b_t_i) )
 
-without ever holding float32 logits in HBM:
+without ever holding float32 logits in HBM. Two schemes, selected by
+`fused_cross_entropy(residual=...)`:
 
-- **forward** (Pallas): one grid pass over (token-block, vocab-block)
-  with the online-logsumexp recurrence in VMEM scratch; the only
-  full-size array written is the *bfloat16* logits residual (half the
-  traffic, and the f32 values never exist outside the MXU accumulator).
-- **backward** (Pallas + XLA): a d-kernel rebuilds
-  `softmax - onehot` blockwise from the bf16 residual and the saved
-  row logsumexp, emitting d in bfloat16 (aliased over the residual
-  buffer) plus the bias gradient; dW and dx are then two plain bf16
-  matmuls (f32 accumulation) that XLA maps straight onto the MXU.
+- **recompute** (default): the forward is one grid pass over
+  (token-block, vocab-block) with the online-logsumexp recurrence in
+  VMEM scratch, saving ONLY the [N, 1] row logsumexp — no [N, V]
+  array of any dtype exists. The backward runs two kernels with
+  opposite grid orders, each rebuilding every logits block from x.W
+  on the fly: the dW kernel (v outer, n inner) accumulates
+  `dW[:, j] = sum_i x_i^T d_ij` and the bias gradient in VMEM; the dx
+  kernel (n outer, v inner) accumulates `dx_i = sum_j d_ij W_j^T`.
+  Cost: two extra bf16 logits passes; saving: ~5 HBM touches of an
+  [N, V] residual.
+- **residual=True**: the forward additionally writes a *bfloat16*
+  logits residual; the backward's d-kernel rebuilds
+  `softmax - onehot` blockwise from that residual (d aliased over the
+  same buffer) and dW/dx are two plain XLA bf16 matmuls. Fewer FLOPs,
+  more HBM traffic — the right trade only when the [N, V] write is
+  cheaper than a logits pass.
 
-All three big matmuls (logits, dW, dx) therefore run in bfloat16 with
-float32 accumulation, and padding/casting happens once in ordinary
+All big matmuls in both schemes run bfloat16 with float32
+accumulation, and padding/casting happens once in ordinary
 differentiable jnp ops outside the custom_vjp (JAX transposes the pad
 to a slice on the way back, so callers see unpadded gradients).
 
@@ -72,7 +80,20 @@ def _fwd_vmem_bytes(bn, h, bv):
     return inputs + outputs + acc + 3 * bn * 4
 
 
-def _pick_blocks(n, h, v):
+def _recompute_vmem_bytes(bn, h, bv):
+    """Worst of the three recompute-path kernels (fwd-no-residual, dW,
+    dx): shared terms are the double-buffered x/W/bias/target/lse
+    inputs and the [bn, bv] f32 logits/d temporary; the dW and dx
+    kernels add their f32 accumulator plus a double-buffered output."""
+    inputs = 2 * (bn * h * 2 + h * bv * 2 + bv * 4 + 2 * bn * 4)
+    d_tmp = bn * bv * 4
+    fwd = inputs + 2 * (2 * bn * 4) + d_tmp + 3 * bn * 4
+    dw = inputs + 2 * (h * bv * 2 + bv * 4) + h * bv * 4 + bv * 4 + d_tmp
+    dx = inputs + 2 * (bn * h * 2) + bn * h * 4 + d_tmp
+    return max(fwd, dw, dx)
+
+
+def _pick_blocks(n, h, v, vmem_bytes=_fwd_vmem_bytes):
     """(bn, bv) fitting the VMEM budget, or None when no block size
     does (very large H — the un-blocked dim); callers then fall back
     to the reference path instead of hitting a Mosaic compile OOM."""
@@ -86,7 +107,7 @@ def _pick_blocks(n, h, v):
         # with grid extent. bv=512 is verified there and costs <1%
         # at the sizes that fit either way.
         bv = 512
-    while _fwd_vmem_bytes(bn, h, bv) > _VMEM_BUDGET:
+    while vmem_bytes(bn, h, bv) > _VMEM_BUDGET:
         if bv > 512:
             bv //= 2
         elif bn > 128:
@@ -114,11 +135,13 @@ def reference_cross_entropy(hidden, kernel, bias, targets):
     return jnp.mean(lse - tl)
 
 
-def _fwd_kernel(x_ref, w_ref, b_ref, t_ref, logits_ref, lse_ref, tl_ref,
+def _fwd_common(x_ref, w_ref, b_ref, t_ref, logits_ref, lse_ref, tl_ref,
                 m_ref, s_ref, tacc_ref, *, block_v):
-    """Grid (n-blocks, v-blocks), v innermost: the x block stays
-    resident while W blocks stream; online-logsumexp state lives in
-    VMEM scratch and the outputs are written on the last v step."""
+    """Shared forward body, grid (n-blocks, v-blocks), v innermost: the
+    x block stays resident while W blocks stream; online-logsumexp
+    state lives in VMEM scratch and the outputs are written on the last
+    v step. `logits_ref=None` (the recompute path) skips the bf16
+    residual store — everything else is identical by construction."""
     j = pl.program_id(1)
     nv = pl.num_programs(1)
 
@@ -132,7 +155,8 @@ def _fwd_kernel(x_ref, w_ref, b_ref, t_ref, logits_ref, lse_ref, tl_ref,
         x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     acc = acc + b_ref[:].astype(jnp.float32)         # [bn, bv]
-    logits_ref[:] = acc.astype(logits_ref.dtype)
+    if logits_ref is not None:
+        logits_ref[:] = acc.astype(logits_ref.dtype)
 
     m = m_ref[:]                                     # [bn, 1]
     m_new = jnp.maximum(m, jnp.max(acc, axis=1, keepdims=True))
@@ -151,6 +175,8 @@ def _fwd_kernel(x_ref, w_ref, b_ref, t_ref, logits_ref, lse_ref, tl_ref,
     def _():
         lse_ref[:] = m_ref[:] + jnp.log(s_ref[:])
         tl_ref[:] = tacc_ref[:]
+
+
 
 
 def _bwd_kernel(scale_ref, logits_ref, lse_ref, t_ref, d_ref, db_ref,
@@ -179,6 +205,181 @@ def _bwd_kernel(scale_ref, logits_ref, lse_ref, t_ref, d_ref, db_ref,
         db_ref[:] = dbacc_ref[:]
 
 
+def _fwd_kernel_nores(x_ref, w_ref, b_ref, t_ref, lse_ref, tl_ref,
+                      m_ref, s_ref, tacc_ref, *, block_v):
+    """`_fwd_common` without the logits residual output: the recompute
+    backward rebuilds every logits block from x.W, so the forward only
+    produces the per-row lse and target logit."""
+    _fwd_common(x_ref, w_ref, b_ref, t_ref, None, lse_ref, tl_ref,
+                m_ref, s_ref, tacc_ref, block_v=block_v)
+
+
+def _recompute_d(x_ref, w_ref, b_ref, t_ref, lse_ref, scale_ref, j,
+                 block_v):
+    """Shared by both recompute backward kernels: rebuild this block's
+    logits from x.W + b and form d = (softmax - onehot) * g/N in f32
+    registers — the [N, V] d matrix never exists outside VMEM."""
+    acc = jax.lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc = acc + b_ref[:].astype(jnp.float32)
+    p = jnp.exp(acc - lse_ref[:])
+    col = t_ref[:] - j * block_v
+    hit = lax.broadcasted_iota(jnp.int32, p.shape, 1) == col
+    valid = (t_ref[:] >= 0).astype(jnp.float32)      # [bn, 1] pad mask
+    return (p - hit.astype(jnp.float32)) * (scale_ref[0, 0] * valid)
+
+
+def _dw_kernel(scale_ref, x_ref, w_ref, b_ref, t_ref, lse_ref,
+               dw_ref, db_ref, dwacc_ref, dbacc_ref, *, block_v):
+    """Grid (v-blocks, n-blocks), n innermost: the W block stays
+    resident while x blocks stream; dW[:, j] = sum_i x_i^T d_ij and the
+    bias gradient accumulate in VMEM scratch across the n sweep."""
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    nn = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _():
+        dwacc_ref[:] = jnp.zeros_like(dwacc_ref)
+        dbacc_ref[:] = jnp.zeros_like(dbacc_ref)
+
+    d = _recompute_d(x_ref, w_ref, b_ref, t_ref, lse_ref, scale_ref, j,
+                     block_v)
+    dwacc_ref[:] += jax.lax.dot_general(
+        x_ref[:], d.astype(x_ref.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dbacc_ref[:] += jnp.sum(d, axis=0, keepdims=True)
+
+    @pl.when(i == nn - 1)
+    def _():
+        dw_ref[:] = dwacc_ref[:].astype(dw_ref.dtype)
+        db_ref[:] = dbacc_ref[:]
+
+
+def _dx_kernel(scale_ref, x_ref, w_ref, b_ref, t_ref, lse_ref, dx_ref,
+               dxacc_ref, *, block_v):
+    """Grid (n-blocks, v-blocks), v innermost: the x block stays
+    resident while W blocks stream; dx_i = sum_j d_ij W_j^T accumulates
+    in VMEM scratch across the v sweep."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        dxacc_ref[:] = jnp.zeros_like(dxacc_ref)
+
+    d = _recompute_d(x_ref, w_ref, b_ref, t_ref, lse_ref, scale_ref, j,
+                     block_v)
+    dxacc_ref[:] += jax.lax.dot_general(
+        d.astype(w_ref.dtype), w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == nv - 1)
+    def _():
+        dx_ref[:] = dxacc_ref[:].astype(dx_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused_ce_recompute(x, w, b, t, bn, bv, interpret):
+    loss, _ = _fcr_fwd(x, w, b, t, bn, bv, interpret)
+    return loss
+
+
+def _fcr_fwd(x, w, b, t, bn, bv, interpret):
+    n_pad, h = x.shape
+    v_pad = w.shape[1]
+    nn, nv = n_pad // bn, v_pad // bv
+    lse, tl = pl.pallas_call(
+        functools.partial(_fwd_kernel_nores, block_v=bv),
+        grid=(nn, nv),
+        in_specs=[
+            pl.BlockSpec((bn, h), lambda i, j: (i, 0)),
+            pl.BlockSpec((h, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn, 1), jnp.float32),   # running max
+            pltpu.VMEM((bn, 1), jnp.float32),   # running sum-exp
+            pltpu.VMEM((bn, 1), jnp.float32),   # target-logit gather
+        ],
+        interpret=interpret,
+    )(x, w, b, t)
+    valid = (t >= 0).astype(jnp.float32)             # [n_pad, 1]
+    num_valid = jnp.maximum(jnp.sum(valid), 1.0)
+    loss = jnp.sum((lse - tl) * valid) / num_valid
+    return loss, (x, w, b, lse, t, num_valid)
+
+
+def _fcr_bwd(bn, bv, interpret, res, g):
+    x, w, b, lse, t, num_valid = res
+    n_pad, h = x.shape
+    v_pad = w.shape[1]
+    nn, nv = n_pad // bn, v_pad // bv
+    scale = (g / num_valid).astype(jnp.float32)[None, None]
+
+    dw, db = pl.pallas_call(
+        functools.partial(_dw_kernel, block_v=bv),
+        grid=(nv, nn),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((bn, h), lambda j, i: (i, 0)),
+            pl.BlockSpec((h, bv), lambda j, i: (0, j)),
+            pl.BlockSpec((1, bv), lambda j, i: (0, j)),
+            pl.BlockSpec((bn, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda j, i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((h, bv), lambda j, i: (0, j)),
+            pl.BlockSpec((1, bv), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, v_pad), w.dtype),
+            jax.ShapeDtypeStruct((1, v_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, bv), jnp.float32),   # dW accumulator
+            pltpu.VMEM((1, bv), jnp.float32),   # db accumulator
+        ],
+        interpret=interpret,
+    )(scale, x, w, b, t, lse)
+
+    dx = pl.pallas_call(
+        functools.partial(_dx_kernel, block_v=bv),
+        grid=(nn, nv),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((bn, h), lambda i, j: (i, 0)),
+            pl.BlockSpec((h, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, h), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, h), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bn, h), jnp.float32),   # dx accumulator
+        ],
+        interpret=interpret,
+    )(scale, x, w, b, t, lse)
+
+    return (dx, dw, db.astype(jnp.float32),
+            np.zeros(t.shape, jax.dtypes.float0))
+
+
+_fused_ce_recompute.defvjp(_fcr_fwd, _fcr_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _fused_ce_padded(x, w, b, t, bn, bv, interpret):
     loss, _ = _fce_fwd(x, w, b, t, bn, bv, interpret)
@@ -190,7 +391,7 @@ def _fce_fwd(x, w, b, t, bn, bv, interpret):
     v_pad = w.shape[1]
     nn, nv = n_pad // bn, v_pad // bv
     logits, lse, tl = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_v=bv),
+        functools.partial(_fwd_common, block_v=bv),
         grid=(nn, nv),
         in_specs=[
             pl.BlockSpec((bn, h), lambda i, j: (i, 0)),
@@ -266,7 +467,8 @@ _fused_ce_padded.defvjp(_fce_fwd, _fce_bwd)
 
 
 def fused_cross_entropy(hidden, kernel, bias, targets,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        residual: bool = False):
     """Mean softmax cross-entropy of `hidden @ kernel + bias` against
     integer `targets`, differentiable in (hidden, kernel, bias).
 
@@ -274,10 +476,20 @@ def fused_cross_entropy(hidden, kernel, bias, targets,
     accumulation), kernel: [H, V], bias: [V], targets: [N] int. Shapes
     whose H is not a multiple of 128 fall back to the plain-XLA
     reference path (`reference_cross_entropy`).
+
+    The default backward RECOMPUTES each logits block from x.W inside
+    the dW and dx kernels (Liger-style), so no [N, V] array of any
+    dtype ever exists — the forward saves only the [N, 1] row
+    logsumexp. Cost: two extra bf16 logits matmul passes in the
+    backward; saving: ~5 HBM touches of the [N, V] bf16 residual
+    (~4 GB at GPT-2-small b=12 scale). `residual=True` keeps the
+    round-4 kernel (bf16 logits residual written forward, d aliased
+    over it backward) for shapes/budgets where the trade flips.
     """
     n, h = hidden.shape
     v = kernel.shape[1]
-    blocks = _pick_blocks(n, h, v) if h % 128 == 0 else None
+    vmem = _fwd_vmem_bytes if residual else _recompute_vmem_bytes
+    blocks = _pick_blocks(n, h, v, vmem) if h % 128 == 0 else None
     if blocks is None:
         return reference_cross_entropy(hidden, kernel, bias, targets)
     if interpret is None:
@@ -292,4 +504,6 @@ def fused_cross_entropy(hidden, kernel, bias, targets,
                 constant_values=_PAD_BIAS)[None, :]
     t = jnp.pad(lax.stop_gradient(targets).astype(jnp.int32),
                 (0, n_pad - n), constant_values=-1)[:, None]
-    return _fused_ce_padded(x, w, b, t, bn, bv, interpret)
+    if residual:
+        return _fused_ce_padded(x, w, b, t, bn, bv, interpret)
+    return _fused_ce_recompute(x, w, b, t, bn, bv, interpret)

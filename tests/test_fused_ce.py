@@ -20,13 +20,15 @@ def _rand(shape, key, dtype=jnp.float32, scale=1.0):
     return (jax.random.normal(key, shape) * scale).astype(dtype)
 
 
+@pytest.mark.parametrize("residual", [False, True],
+                         ids=["recompute", "residual"])
 @pytest.mark.parametrize("n,h,v", [
     (64, 128, 1000),      # v not a block multiple -> vocab padding
     (100, 128, 512),      # n not a sublane multiple -> row padding
     (512, 256, 2048),     # exact tiling, multiple blocks both ways
     (1000, 128, 50257),   # GPT-2 vocab: big ragged pad
 ])
-def test_matches_reference_f32(n, h, v):
+def test_matches_reference_f32(n, h, v, residual):
     ks = jax.random.split(jax.random.PRNGKey(0), 4)
     x = _rand((n, h), ks[0])
     w = _rand((h, v), ks[1], scale=0.02)
@@ -36,7 +38,9 @@ def test_matches_reference_f32(n, h, v):
     ref_loss, ref_grads = jax.value_and_grad(
         reference_cross_entropy, argnums=(0, 1, 2))(x, w, b, t)
     loss, grads = jax.value_and_grad(
-        fused_cross_entropy, argnums=(0, 1, 2))(x, w, b, t)
+        lambda x, w, b, t: fused_cross_entropy(x, w, b, t,
+                                               residual=residual),
+        argnums=(0, 1, 2))(x, w, b, t)
 
     # forward lse/target-logit accumulate in f32 from bf16-rounded
     # matmul inputs; CE is ~|logit| scale so 1e-2 abs is bf16-grade
