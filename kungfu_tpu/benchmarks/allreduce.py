@@ -1,0 +1,161 @@
+"""End-to-end DCN all-reduce data rate over the libkf control plane.
+
+The reference's headline collective microbenchmark
+(reference: tests/go/cmd/kungfu-bench-allreduce/kungfu-bench-allreduce.go:40-105)
+all-reduces a fake model's full tensor set per "epoch" and publishes the
+ring-equivalent data rate `epochs * 4 * (np - 1) * model_bytes / time`.
+This module is the repo equivalent for the DCN plane: np kfrun-launched
+worker processes all-reduce the real flax models' parameter catalogs
+(`models/fake_models.py`, derived with jax.eval_shape, never drifting
+from the architecture) through `Peer.all_reduce` — the same libkf
+session/transport stack elasticity and host-averaging ride on.
+
+Two entry modes:
+
+  # worker (launched by kfrun; rank 0 writes its JSON to $KF_BENCH_OUT)
+  python -m kungfu_tpu.benchmarks.allreduce --worker --model resnet50-imagenet
+
+  # driver: spawns kfrun per (np, strategy), prints one JSON line
+  python -m kungfu_tpu.benchmarks.allreduce --np 2,4 --strategies RING,AUTO
+
+The rate multiplier follows the reference exactly: a rank contributes
+and collects `(np-1)/np` of the buffer twice (reduce-scatter +
+all-gather), and the reference counts both directions across all ranks
+without the 1/np factor — `4 * (np - 1) * bytes` per epoch — so the
+numbers are directly comparable to its published rates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+STRATEGIES = ("RING", "BINARY_TREE_STAR", "AUTO")
+
+
+def worker_main(model: str, epochs: int, warmup: int, fuse: bool) -> None:
+    import numpy as np
+
+    import kungfu_tpu
+    from kungfu_tpu.models.fake_models import fake_model_catalog
+
+    p = kungfu_tpu.init()
+    counts = fake_model_catalog(model, fuse=fuse)
+    rng = np.random.default_rng(p.rank)
+    bufs = {name: rng.standard_normal(n).astype(np.float32)
+            for name, n in counts.items()}
+    total_bytes = sum(b.nbytes for b in bufs.values())
+
+    def epoch():
+        for name, b in bufs.items():
+            p.all_reduce(b, name=f"ar:{name}")
+
+    p.barrier()
+    for _ in range(warmup):
+        epoch()
+    p.barrier()
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        epoch()
+    p.barrier()
+    dt = time.perf_counter() - t0
+
+    if p.rank == 0:
+        workload = epochs * 4 * (p.size - 1) * total_bytes
+        out = {
+            "np": p.size,
+            "model": model,
+            "tensors": len(bufs),
+            "model_bytes": total_bytes,
+            "epochs": epochs,
+            "seconds": round(dt, 4),
+            "rate_gbps": round(workload / dt / 1e9, 3),
+            "equivalent_rate_formula": "4*(np-1)*bytes*epochs/time",
+        }
+        path = os.environ.get("KF_BENCH_OUT")
+        if path:
+            with open(path, "w") as f:
+                json.dump(out, f)
+        else:
+            print(json.dumps(out), flush=True)
+    p.stop()
+
+
+def run_one(np_: int, strategy: str, model: str, epochs: int,
+            warmup: int, fuse: bool, port_range: str,
+            timeout: float = 300.0) -> dict:
+    """Launch one kfrun job and return rank 0's measurement dict."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    with tempfile.TemporaryDirectory(prefix="kf-arbench-") as td:
+        out_path = os.path.join(td, "rank0.json")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["KF_BENCH_OUT"] = out_path
+        env.setdefault("KF_LOG_LEVEL", "warn")
+        # control-plane workers must not touch the (process-exclusive)
+        # TPU: the catalog init alone would acquire it in every worker
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, "-m", "kungfu_tpu.run",
+               "-np", str(np_), "-strategy", strategy,
+               "-port-range", port_range,
+               "-logdir", os.path.join(td, "logs"), "-q", "--",
+               sys.executable, "-m", "kungfu_tpu.benchmarks.allreduce",
+               "--worker", "--model", model, "--epochs", str(epochs),
+               "--warmup", str(warmup)] + (["--fuse"] if fuse else [])
+        r = subprocess.run(cmd, env=env, cwd=repo, timeout=timeout,
+                           capture_output=True, text=True)
+        if r.returncode != 0 or not os.path.exists(out_path):
+            raise RuntimeError(
+                f"np={np_} strategy={strategy} failed rc={r.returncode}:"
+                f"\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+        with open(out_path) as f:
+            row = json.load(f)
+    row["strategy"] = strategy
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--model", default="resnet50-imagenet")
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--fuse", action="store_true",
+                    help="one fused buffer instead of per-tensor")
+    ap.add_argument("--np", default="2,4",
+                    help="comma-separated worker counts (driver mode)")
+    ap.add_argument("--strategies", default="RING,BINARY_TREE_STAR,AUTO")
+    ap.add_argument("--port-range", default="11000-12500")
+    args = ap.parse_args()
+    if args.worker:
+        worker_main(args.model, args.epochs, args.warmup, args.fuse)
+        return
+    strategies = args.strategies.split(",")
+    bad = [s for s in strategies if s not in STRATEGIES]
+    if bad:
+        raise SystemExit(f"unknown strategies {bad}; valid: {STRATEGIES}")
+    rows = []
+    for np_ in [int(s) for s in args.np.split(",")]:
+        for strategy in strategies:
+            rows.append(run_one(np_, strategy, args.model, args.epochs,
+                                args.warmup, args.fuse, args.port_range))
+            print(json.dumps(rows[-1]), flush=True)
+    best = max(rows, key=lambda r: r["rate_gbps"])
+    print(json.dumps({
+        "metric": "dcn_allreduce_equivalent_rate",
+        "value": best["rate_gbps"], "unit": "GB/s",
+        "model": args.model,
+        "best": {k: best[k] for k in ("np", "strategy", "rate_gbps")},
+        "rows": [{k: r[k] for k in ("np", "strategy", "rate_gbps",
+                                    "seconds")} for r in rows],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -10,13 +10,16 @@ was off. This module replaces it with numbers that can close:
    within an instruction — one HBM read feeds every in-fusion use),
    and bucket by kind (convolution, BN/reduce, elementwise, copy).
    Parameters and constants are charged on read like any operand.
-2. **Achieved-bandwidth microbenchmark**: a pure streaming kernel
-   (z = x + y over ~0.5 GiB) measures what this chip actually
-   sustains through the same jit/dispatch path — the honest
-   denominator for "at roofline", below the paper number.
+2. **Achieved-bandwidth suite**: streaming kernels over ~0.5 GiB in
+   several access patterns (f32 add, bf16 add, bf16 copy, bf16 4-way
+   fan-in) measure what this chip actually sustains through the same
+   jit/dispatch path. The max over patterns is the honest denominator
+   for "at roofline" — a single f32 add underestimates what a step
+   full of concurrent bf16 DMA streams can pull.
 
 Prints the table plus ONE JSON line with the reconciliation:
-demand GB/step, step ms, implied GB/s, achieved GB/s, ratio.
+demand GB/step, step ms, implied GB/s, achieved GB/s by pattern, the
+best-pattern fraction, and a `reconciles` verdict.
 
   python -m kungfu_tpu.benchmarks.roofline            # full (TPU)
   python -m kungfu_tpu.benchmarks.roofline --no-bench # HLO table only
@@ -151,29 +154,77 @@ def build_resnet_step():
 
 
 def measure_achieved_bandwidth(gib: float = 0.5, iters: int = 20):
-    """Sustained HBM GB/s of a pure streaming add (2 reads + 1 write).
+    """Sustained HBM GB/s of a pure f32 streaming add (2 reads + 1
+    write) — kept as the round-4 comparable number.
 
     The `iters` additions are CHAINED INSIDE one jit (fori_loop with a
     data dependency): on a relayed backend (axon) every host-side
     fence costs ~100 ms of round-trip latency, so per-iteration
     fencing would understate bandwidth ~50x."""
+    return measure_bandwidth_suite(gib, iters, patterns=("f32_add",)
+                                   )["f32_add"]
+
+
+def measure_bandwidth_suite(gib: float = 0.5, iters: int = 20,
+                            patterns=("f32_add", "bf16_add", "bf16_copy",
+                                      "bf16_fan_in4")):
+    """GB/s by access pattern. A single f32 elementwise add is the
+    WRONG ceiling for a step whose traffic is mostly bf16 tensors
+    moving through many concurrent DMA streams: bf16 halves the
+    bytes-per-lane cost, a pure copy skips the VPU, and a 4-input add
+    exercises DMA concurrency. The honest "delivered bandwidth"
+    denominator for a roofline claim is the max over patterns — if the
+    step's implied GB/s exceeds even that, the traffic model
+    overcounts; if it sits between the f32-add figure and the max, the
+    step is simply sustaining more DMA concurrency than one chained
+    add does."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    n = int(gib * (1 << 30) / 4)
-    x = jnp.arange(n, dtype=jnp.float32)
-    y = jnp.ones((n,), jnp.float32)
+    def timed(run, *args, nbytes):
+        out = run(*args)
+        float(out.reshape(-1)[0].astype(jnp.float32))  # compile+warm
+        t0 = time.perf_counter()
+        out = run(*args)
+        float(out.reshape(-1)[0].astype(jnp.float32))
+        dt = (time.perf_counter() - t0) / iters
+        return nbytes / dt / 1e9
 
-    @jax.jit
-    def run(x, y):
-        return lax.fori_loop(0, iters, lambda i, z: z + y, x)
-
-    float(run(x, y)[0])                      # compile + warm
-    t0 = time.perf_counter()
-    float(run(x, y)[0])                      # one fence for all iters
-    dt = (time.perf_counter() - t0) / iters
-    return 3 * n * 4 / dt / 1e9
+    results = {}
+    if "f32_add" in patterns:
+        n = int(gib * (1 << 30) / 4)
+        x = jnp.arange(n, dtype=jnp.float32)
+        y = jnp.ones((n,), jnp.float32)
+        run = jax.jit(lambda x, y: lax.fori_loop(
+            0, iters, lambda i, z: z + y, x))
+        results["f32_add"] = timed(run, x, y, nbytes=3 * n * 4)
+    n = int(gib * (1 << 30) / 2)
+    if "bf16_add" in patterns:
+        xb = jnp.ones((n,), jnp.bfloat16)
+        yb = jnp.ones((n,), jnp.bfloat16) * 1.0009765625  # exact bf16
+        run = jax.jit(lambda x, y: lax.fori_loop(
+            0, iters, lambda i, z: z + y, x))
+        results["bf16_add"] = timed(run, xb, yb, nbytes=3 * n * 2)
+    if "bf16_copy" in patterns:
+        # z = -z: reads and rewrites every element with no second
+        # operand — 1r + 1w, the lightest VPU load XLA won't fold away
+        xc = jnp.ones((n,), jnp.bfloat16)
+        run = jax.jit(lambda x: lax.fori_loop(
+            0, iters, lambda i, z: -z, x))
+        results["bf16_copy"] = timed(run, xc, nbytes=2 * n * 2)
+    if "bf16_fan_in4" in patterns:
+        m = n // 4
+        a, b, c, d = (jnp.full((m,), float(k + 1) / 7, jnp.bfloat16)
+                      for k in range(4))
+        # strict left association: every partial sum depends on the
+        # carry, so no operand pair is loop-invariant and hoistable
+        run = jax.jit(lambda a, b, c, d: lax.fori_loop(
+            0, iters, lambda i, z: (((z + b) + c) + d).astype(
+                jnp.bfloat16), a))
+        results["bf16_fan_in4"] = timed(run, a, b, c, d,
+                                        nbytes=4 * m * 2 + m * 2)
+    return {k: round(v, 1) for k, v in results.items()}
 
 
 def main(argv=None) -> int:
@@ -211,7 +262,9 @@ def main(argv=None) -> int:
               "value": round(total_gb, 2), "unit": "GB/step",
               "platform": platform}
     if not args.no_bench and platform != "cpu":
-        achieved = measure_achieved_bandwidth()
+        suite = measure_bandwidth_suite()
+        achieved = suite["f32_add"]
+        best = max(suite.values())
         iters = 20
         p, s, o, loss = step(*step_args)          # compile
         for _ in range(2):                        # warm (match bench.py)
@@ -230,7 +283,10 @@ def main(argv=None) -> int:
             "step_ms": round(dt * 1000, 2),
             "implied_gb_per_s": round(implied, 1),
             "achieved_streaming_gb_per_s": round(achieved, 1),
-            "fraction_of_achieved": round(implied / achieved, 3),
+            "achieved_by_pattern_gb_per_s": suite,
+            "best_achieved_gb_per_s": round(best, 1),
+            "fraction_of_best_achieved": round(implied / best, 3),
+            "reconciles": bool(implied <= best * 1.05),
         })
     print(json.dumps(result))
     return 0

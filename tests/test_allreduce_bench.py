@@ -1,0 +1,27 @@
+"""CI smoke for the DCN all-reduce data-rate benchmark.
+
+Drives the real driver path (`benchmarks/allreduce.py` -> kfrun -> np
+worker processes -> libkf collectives) at np=2 on a small catalog model
+— the reference's kungfu-bench-allreduce exercised the same way its CI
+ran it (reference: tests/go/cmd/kungfu-bench-allreduce).
+"""
+
+from kungfu_tpu.benchmarks.allreduce import run_one
+
+
+def test_np2_ring_smoke():
+    row = run_one(2, "RING", "mlp-mnist", epochs=2, warmup=1,
+                  fuse=False, port_range="12600-12800")
+    assert row["np"] == 2
+    assert row["strategy"] == "RING"
+    assert row["tensors"] > 1          # per-tensor mode, real catalog
+    assert row["model_bytes"] > 100_000
+    assert row["rate_gbps"] > 0
+    assert row["equivalent_rate_formula"] == "4*(np-1)*bytes*epochs/time"
+
+
+def test_np2_fused_auto_smoke():
+    row = run_one(2, "AUTO", "mlp-mnist", epochs=2, warmup=1,
+                  fuse=True, port_range="12810-12990")
+    assert row["tensors"] == 1         # fused: one packed buffer
+    assert row["rate_gbps"] > 0
