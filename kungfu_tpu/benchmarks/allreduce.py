@@ -38,7 +38,10 @@ import time
 STRATEGIES = ("RING", "BINARY_TREE_STAR", "AUTO")
 
 
-def worker_main(model: str, epochs: int, warmup: int, fuse: bool) -> None:
+def worker_main(model: str, epochs: int, warmup: int, fuse: bool,
+                mode: str = "seq") -> None:
+    from concurrent.futures import ThreadPoolExecutor
+
     import numpy as np
 
     import kungfu_tpu
@@ -51,9 +54,22 @@ def worker_main(model: str, epochs: int, warmup: int, fuse: bool) -> None:
             for name, n in counts.items()}
     total_bytes = sum(b.nbytes for b in bufs.values())
 
+    # mirror the reference's two epoch structures
+    # (kungfu-bench-allreduce.go:51-64 + taskgroup Par/Seq): "seq"
+    # awaits each tensor before the next; "par" issues every tensor's
+    # all-reduce concurrently — rendezvous is name-keyed, so arrival
+    # order across ranks doesn't matter
+    pool = ThreadPoolExecutor(max_workers=8) if mode == "par" else None
+
     def epoch():
-        for name, b in bufs.items():
-            p.all_reduce(b, name=f"ar:{name}")
+        if pool is None:
+            for name, b in bufs.items():
+                p.all_reduce(b, name=f"ar:{name}")
+        else:
+            futs = [pool.submit(p.all_reduce, b, name=f"ar:{name}")
+                    for name, b in bufs.items()]
+            for f in futs:
+                f.result()
 
     p.barrier()
     for _ in range(warmup):
@@ -70,6 +86,7 @@ def worker_main(model: str, epochs: int, warmup: int, fuse: bool) -> None:
         out = {
             "np": p.size,
             "model": model,
+            "mode": mode,
             "tensors": len(bufs),
             "model_bytes": total_bytes,
             "epochs": epochs,
@@ -88,7 +105,7 @@ def worker_main(model: str, epochs: int, warmup: int, fuse: bool) -> None:
 
 def run_one(np_: int, strategy: str, model: str, epochs: int,
             warmup: int, fuse: bool, port_range: str,
-            timeout: float = 300.0) -> dict:
+            timeout: float = 300.0, mode: str = "seq") -> dict:
     """Launch one kfrun job and return rank 0's measurement dict."""
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -107,7 +124,8 @@ def run_one(np_: int, strategy: str, model: str, epochs: int,
                "-logdir", os.path.join(td, "logs"), "-q", "--",
                sys.executable, "-m", "kungfu_tpu.benchmarks.allreduce",
                "--worker", "--model", model, "--epochs", str(epochs),
-               "--warmup", str(warmup)] + (["--fuse"] if fuse else [])
+               "--warmup", str(warmup), "--mode", mode] \
+            + (["--fuse"] if fuse else [])
         r = subprocess.run(cmd, env=env, cwd=repo, timeout=timeout,
                            capture_output=True, text=True)
         if r.returncode != 0 or not os.path.exists(out_path):
@@ -128,13 +146,17 @@ def main():
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--fuse", action="store_true",
                     help="one fused buffer instead of per-tensor")
+    ap.add_argument("--mode", default="seq", choices=("seq", "par"),
+                    help="await tensors one-by-one (seq) or issue all "
+                         "concurrently (par), like the reference")
     ap.add_argument("--np", default="2,4",
                     help="comma-separated worker counts (driver mode)")
     ap.add_argument("--strategies", default="RING,BINARY_TREE_STAR,AUTO")
     ap.add_argument("--port-range", default="11000-12500")
     args = ap.parse_args()
     if args.worker:
-        worker_main(args.model, args.epochs, args.warmup, args.fuse)
+        worker_main(args.model, args.epochs, args.warmup, args.fuse,
+                    args.mode)
         return
     strategies = args.strategies.split(",")
     bad = [s for s in strategies if s not in STRATEGIES]
@@ -144,13 +166,14 @@ def main():
     for np_ in [int(s) for s in args.np.split(",")]:
         for strategy in strategies:
             rows.append(run_one(np_, strategy, args.model, args.epochs,
-                                args.warmup, args.fuse, args.port_range))
+                                args.warmup, args.fuse, args.port_range,
+                                mode=args.mode))
             print(json.dumps(rows[-1]), flush=True)
     best = max(rows, key=lambda r: r["rate_gbps"])
     print(json.dumps({
         "metric": "dcn_allreduce_equivalent_rate",
         "value": best["rate_gbps"], "unit": "GB/s",
-        "model": args.model,
+        "model": args.model, "mode": args.mode,
         "best": {k: best[k] for k in ("np", "strategy", "rate_gbps")},
         "rows": [{k: r[k] for k in ("np", "strategy", "rate_gbps",
                                     "seconds")} for r in rows],

@@ -64,7 +64,8 @@ def _train_mfu(cfg, tokens_per_sec, seq, n_chips):
 def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
                     tp: int = 1, attention: str = "local",
                     iters: int = 10, warmup: int = 2, experts: int = 0,
-                    moe_group: int = 0, moe_bf16: bool = False):
+                    moe_group: int = 0, moe_bf16: bool = False,
+                    remat: bool = False, residual_ce: bool = False):
     """Tokens/sec of LM training. Returns (tokens_per_sec, meta).
 
     `experts` > 0 swaps the dense FFN for the Switch MoE (global expert
@@ -100,7 +101,8 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
                     max_position=max(1024, seq), dtype=jnp.bfloat16,
                     attention=attention, num_experts=experts,
                     moe_group_size=moe_group,
-                    moe_param_dtype=jnp.bfloat16 if moe_bf16 else None)
+                    moe_param_dtype=jnp.bfloat16 if moe_bf16 else None,
+                    remat=remat)
     model = GPTLM(cfg)
 
     d_data = n // tp
@@ -135,10 +137,12 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
             lambda p, t: gpt_loss_with_aux(model, p, t, fused=(n == 1)),
             tx, has_aux=True)
     elif n == 1:
-        # fused head+CE: the [B, T, V] f32 logits never touch HBM
-        # (ops/fused_ce.py; +20% tok/s at gpt2-small on v5e)
+        # fused head+CE: no [B, T, V] array of any dtype touches HBM
+        # (ops/fused_ce.py recompute backward; residual_ce keeps the
+        # round-4 bf16-residual kernel for A/B comparison)
         step = build_gspmd_train_step(
-            lambda p, t: gpt_fused_loss(model, p, t), tx)
+            lambda p, t: gpt_fused_loss(model, p, t,
+                                        residual=residual_ce), tx)
     elif tp == 1:
         # multi-chip dp: shard_map keeps the fused Pallas kernel inside
         # the per-shard region (the GSPMD partitioner has no rule for
@@ -177,6 +181,22 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
             cfg, global_tokens / dt, seq, n),
         "device_kind": jax.devices()[0].device_kind,
     }
+    if remat:
+        meta["remat"] = True
+    # which branches actually run the fused head (see step selection):
+    # MoE only single-chip; dense whenever tp == 1 (gspmd or dp
+    # shard_map). Label the backward variant; refuse --residual-ce on
+    # paths that never see the flag instead of mislabeling the row.
+    fused_runs = (n == 1) if experts else (tp == 1)
+    residual_plumbed = not experts and n == 1
+    if residual_ce and not residual_plumbed:
+        raise SystemExit(
+            "--residual-ce selects the fused-CE backward variant, but "
+            "only the single-chip dense path plumbs it; this "
+            "configuration would run the default backward and the row "
+            "would be mislabeled")
+    if fused_runs:
+        meta["fused_ce"] = "residual" if residual_ce else "recompute"
     if experts:
         from kungfu_tpu.models.gpt import effective_moe_group
 
@@ -353,6 +373,12 @@ def main():
     ap.add_argument("--moe-bf16", action="store_true",
                     help="(--experts) store expert stacks in bfloat16 "
                          "instead of f32 master weights")
+    ap.add_argument("--remat", action="store_true",
+                    help="checkpoint each Block (recompute activations "
+                         "in the backward)")
+    ap.add_argument("--residual-ce", action="store_true",
+                    help="round-4 bf16-residual fused-CE backward "
+                         "instead of the recompute backward")
     ap.add_argument("--pp", type=int, default=0,
                     help="1F1B pipeline over this many stages")
     ap.add_argument("--microbatches", type=int, default=8,
@@ -365,6 +391,11 @@ def main():
     ap.add_argument("--gen-len", type=int, default=128,
                     help="(--decode) generated tokens")
     args = ap.parse_args()
+    if (args.decode or args.pp) and (args.remat or args.residual_ce):
+        raise SystemExit(
+            "--remat/--residual-ce only apply to the dense/MoE train "
+            "path (measure_lm_rate); they are not plumbed through "
+            "--pp or --decode and would be silently ignored")
     if args.decode:
         if args.attention != "local":
             raise SystemExit(
@@ -389,7 +420,9 @@ def main():
                                  args.tp, args.attention, args.iters,
                                  experts=args.experts,
                                  moe_group=args.moe_group,
-                                 moe_bf16=args.moe_bf16)
+                                 moe_bf16=args.moe_bf16,
+                                 remat=args.remat,
+                                 residual_ce=args.residual_ce)
     print(json.dumps({"metric": "gpt_tokens_per_sec",
                       "value": round(rate, 1), "unit": "tokens/sec",
                       "details": meta}))

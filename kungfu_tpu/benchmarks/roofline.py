@@ -202,7 +202,7 @@ def measure_bandwidth_suite(gib: float = 0.5, iters: int = 20,
     n = int(gib * (1 << 30) / 2)
     if "bf16_add" in patterns:
         xb = jnp.ones((n,), jnp.bfloat16)
-        yb = jnp.ones((n,), jnp.bfloat16) * 1.0009765625  # exact bf16
+        yb = jnp.ones((n,), jnp.bfloat16) * 1.0078125  # 1 + 2^-7, exact bf16
         run = jax.jit(lambda x, y: lax.fori_loop(
             0, iters, lambda i, z: z + y, x))
         results["bf16_add"] = timed(run, xb, yb, nbytes=3 * n * 2)

@@ -83,6 +83,13 @@ class GPTConfig:
     # benchmarks/lm.py) to keep both moments f32 while params stay
     # bf16.
     moe_param_dtype: Any = None
+    # rematerialize each Block in the backward (jax.checkpoint via
+    # nn.remat): trades one extra forward's FLOPs per block for not
+    # storing its activations — the knob to try before concluding a
+    # batch size is HBM-capacity-bound (gpt2-medium b=16 diagnosis,
+    # round-5). decode/prefill are static so the KV-cache paths are
+    # unaffected.
+    remat: bool = False
 
     def __post_init__(self):
         if self.attention not in _ATTN_MODES:
@@ -354,8 +361,17 @@ class GPTLM(nn.Module):
                      name="wte")(token_ids)
         x = x + nn.Embed(c.max_position, c.hidden_size, dtype=c.dtype,
                          name="wpe")(pos)
-        for _ in range(c.num_layers):
-            x = Block(c)(x, decode=decode, prefill=prefill)
+        # static_argnums index flax's inner core_fn, whose args are
+        # (module, x, decode, prefill) -> decode=2, prefill=3; the
+        # bools select traced branches and must stay static under
+        # checkpointing. Explicit Block_{i} names keep the param tree
+        # identical to the uncheckpointed model (flax would otherwise
+        # name these CheckpointBlock_{i}), so checkpoints and
+        # stack_gpt_blocks see one layout.
+        block_cls = (nn.remat(Block, static_argnums=(2, 3))
+                     if c.remat else Block)
+        for i in range(c.num_layers):
+            x = block_cls(c, name=f"Block_{i}")(x, decode, prefill)
         x = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32)(x)
         if return_hidden:
             # training fast path: the caller feeds these states to
@@ -380,7 +396,8 @@ def gpt_loss(logits, token_ids):
 
 
 def gpt_fused_loss(model: GPTLM, params, token_ids,
-                   interpret: bool | None = None):
+                   interpret: bool | None = None,
+                   residual: bool = False):
     """`gpt_loss`, but through `ops.fused_ce.fused_cross_entropy`.
 
     Runs the trunk with `return_hidden=True` and applies the lm_head
@@ -404,7 +421,8 @@ def gpt_fused_loss(model: GPTLM, params, token_ids,
     return fused_cross_entropy(
         hidden[:, :-1].reshape(b * (t - 1), h),
         params["lm_head"]["kernel"], params["lm_head"]["bias"],
-        token_ids[:, 1:].reshape(-1), interpret=interpret)
+        token_ids[:, 1:].reshape(-1), interpret=interpret,
+        residual=residual)
 
 
 def gpt_loss_with_aux(model: GPTLM, params, token_ids,

@@ -562,3 +562,45 @@ class TestGenerate:
         prompt = jnp.zeros((1, CFG.max_position - 2), jnp.int32)
         with pytest.raises(ValueError, match="max_position"):
             gpt_generate(model, params, prompt, num_steps=5)
+
+
+class TestRemat:
+    """GPTConfig(remat=True): checkpointed blocks must be a pure
+    memory/FLOP trade — identical params tree, loss, grads, and
+    KV-cached generation."""
+
+    KW = dict(vocab_size=211, hidden_size=128, num_layers=2,
+              num_heads=4, intermediate_size=256, max_position=48)
+
+    def test_remat_param_tree_and_grads_identical(self):
+        from kungfu_tpu.models import gpt_fused_loss
+
+        m = GPTLM(GPTConfig(**self.KW))
+        mr = GPTLM(GPTConfig(**self.KW, remat=True))
+        toks = jax.random.randint(jax.random.PRNGKey(0), (2, 48), 0,
+                                  self.KW["vocab_size"])
+        p = m.init(jax.random.PRNGKey(1), toks[:1])["params"]
+        pr = mr.init(jax.random.PRNGKey(1), toks[:1])["params"]
+        assert (jax.tree_util.tree_structure(p)
+                == jax.tree_util.tree_structure(pr))
+        l1, g1 = jax.value_and_grad(
+            lambda p: gpt_fused_loss(m, p, toks))(p)
+        l2, g2 = jax.value_and_grad(
+            lambda p: gpt_fused_loss(mr, p, toks))(p)
+        assert float(l1) == float(l2)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+
+    def test_remat_generation_matches(self):
+        from kungfu_tpu.models import gpt_generate
+
+        m = GPTLM(GPTConfig(**self.KW))
+        mr = GPTLM(GPTConfig(**self.KW, remat=True))
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0,
+                                    self.KW["vocab_size"])
+        p = m.init(jax.random.PRNGKey(3), prompt)["params"]
+        a = gpt_generate(m, p, prompt, 6)
+        b = gpt_generate(mr, p, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
