@@ -56,10 +56,12 @@ def worker_main(model: str, epochs: int, warmup: int, fuse: bool,
 
     # mirror the reference's two epoch structures
     # (kungfu-bench-allreduce.go:51-64 + taskgroup Par/Seq): "seq"
-    # awaits each tensor before the next; "par" issues every tensor's
-    # all-reduce concurrently — rendezvous is name-keyed, so arrival
-    # order across ranks doesn't matter
-    pool = ThreadPoolExecutor(max_workers=8) if mode == "par" else None
+    # awaits each tensor before the next; "par" puts the FULL tensor
+    # set in flight at once like the reference's taskgroup Par —
+    # rendezvous is name-keyed, so arrival order across ranks doesn't
+    # matter
+    pool = (ThreadPoolExecutor(max_workers=max(1, len(bufs)))
+            if mode == "par" else None)
 
     def epoch():
         if pool is None:

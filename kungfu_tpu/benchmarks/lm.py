@@ -65,7 +65,7 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
                     tp: int = 1, attention: str = "local",
                     iters: int = 10, warmup: int = 2, experts: int = 0,
                     moe_group: int = 0, moe_bf16: bool = False,
-                    remat: bool = False, residual_ce: bool = False):
+                    remat: bool = False, ce_variant: str = "residual"):
     """Tokens/sec of LM training. Returns (tokens_per_sec, meta).
 
     `experts` > 0 swaps the dense FFN for the Switch MoE (global expert
@@ -122,6 +122,10 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     upcast = optax.stateless(
         lambda updates, _: jax.tree_util.tree_map(
             lambda u: u.astype(jnp.float32), updates))
+    # per-leaf adamw: the flat-buffer variant (optimizers/fused.py)
+    # was profiled and REGRESSED the step 108.5 -> 131.1 ms on v5e
+    # (concat lowers to a serial DUS loop + per-leaf relayouts); see
+    # docs/benchmarks.md round-5 attribution
     tx = optax.chain(upcast, optax.adamw(1e-4))
     # init the moments from f32-cast shapes: zeros_like(bf16 params)
     # would give bf16 mu/nu avals that flip to f32 after the first
@@ -137,12 +141,13 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
             lambda p, t: gpt_loss_with_aux(model, p, t, fused=(n == 1)),
             tx, has_aux=True)
     elif n == 1:
-        # fused head+CE: no [B, T, V] array of any dtype touches HBM
-        # (ops/fused_ce.py recompute backward; residual_ce keeps the
-        # round-4 bf16-residual kernel for A/B comparison)
+        # fused head+CE (ops/fused_ce.py): "residual" (default,
+        # measured faster — 113.2k vs 105.5k tok/s at small-b12) or
+        # "recompute" (no [N, V] array at all; the long-context
+        # memory-bound variant)
         step = build_gspmd_train_step(
-            lambda p, t: gpt_fused_loss(model, p, t,
-                                        residual=residual_ce), tx)
+            lambda p, t: gpt_fused_loss(
+                model, p, t, residual=(ce_variant == "residual")), tx)
     elif tp == 1:
         # multi-chip dp: shard_map keeps the fused Pallas kernel inside
         # the per-shard region (the GSPMD partitioner has no rule for
@@ -185,18 +190,20 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
         meta["remat"] = True
     # which branches actually run the fused head (see step selection):
     # MoE only single-chip; dense whenever tp == 1 (gspmd or dp
-    # shard_map). Label the backward variant; refuse --residual-ce on
-    # paths that never see the flag instead of mislabeling the row.
+    # shard_map). Label the backward variant; refuse a non-default
+    # --ce-variant on paths that never see the flag instead of
+    # mislabeling the row.
     fused_runs = (n == 1) if experts else (tp == 1)
-    residual_plumbed = not experts and n == 1
-    if residual_ce and not residual_plumbed:
+    variant_plumbed = not experts and n == 1
+    if ce_variant != "residual" and not variant_plumbed:
         raise SystemExit(
-            "--residual-ce selects the fused-CE backward variant, but "
-            "only the single-chip dense path plumbs it; this "
-            "configuration would run the default backward and the row "
-            "would be mislabeled")
+            "--ce-variant selects the fused-CE backward, but only the "
+            "single-chip dense path plumbs it; this configuration "
+            "would run the default backward and the row would be "
+            "mislabeled")
     if fused_runs:
-        meta["fused_ce"] = "residual" if residual_ce else "recompute"
+        meta["fused_ce"] = (ce_variant if variant_plumbed
+                            else "residual")
     if experts:
         from kungfu_tpu.models.gpt import effective_moe_group
 
@@ -376,9 +383,11 @@ def main():
     ap.add_argument("--remat", action="store_true",
                     help="checkpoint each Block (recompute activations "
                          "in the backward)")
-    ap.add_argument("--residual-ce", action="store_true",
-                    help="round-4 bf16-residual fused-CE backward "
-                         "instead of the recompute backward")
+    ap.add_argument("--ce-variant", default="residual",
+                    choices=("residual", "recompute"),
+                    help="fused-CE backward: bf16-logits residual "
+                         "(default, faster at GPT-2 scale) or full "
+                         "recompute (memory-independent of N*V)")
     ap.add_argument("--pp", type=int, default=0,
                     help="1F1B pipeline over this many stages")
     ap.add_argument("--microbatches", type=int, default=8,
@@ -391,9 +400,10 @@ def main():
     ap.add_argument("--gen-len", type=int, default=128,
                     help="(--decode) generated tokens")
     args = ap.parse_args()
-    if (args.decode or args.pp) and (args.remat or args.residual_ce):
+    if (args.decode or args.pp) and (args.remat
+                                     or args.ce_variant != "residual"):
         raise SystemExit(
-            "--remat/--residual-ce only apply to the dense/MoE train "
+            "--remat/--ce-variant only apply to the dense/MoE train "
             "path (measure_lm_rate); they are not plumbed through "
             "--pp or --decode and would be silently ignored")
     if args.decode:
@@ -422,7 +432,7 @@ def main():
                                  moe_group=args.moe_group,
                                  moe_bf16=args.moe_bf16,
                                  remat=args.remat,
-                                 residual_ce=args.residual_ce)
+                                 ce_variant=args.ce_variant)
     print(json.dumps({"metric": "gpt_tokens_per_sec",
                       "value": round(rate, 1), "unit": "tokens/sec",
                       "details": meta}))

@@ -397,7 +397,7 @@ def gpt_loss(logits, token_ids):
 
 def gpt_fused_loss(model: GPTLM, params, token_ids,
                    interpret: bool | None = None,
-                   residual: bool = False):
+                   residual: bool = True):
     """`gpt_loss`, but through `ops.fused_ce.fused_cross_entropy`.
 
     Runs the trunk with `return_hidden=True` and applies the lm_head

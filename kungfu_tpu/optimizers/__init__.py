@@ -19,6 +19,7 @@ a mesh axis), so the communication compiles onto ICI.
 """
 
 from .ada_sgd import ada_sgd
+from .fused import flatten_optimizer
 from .async_sgd import PairAveragingState, pair_averaging
 from .monitors import (
     attach_gradient_noise_scale,
