@@ -93,6 +93,21 @@ def measure_rate(model_name: str, n: int, batch: int = 0, iters: int = 20,
     step = build_train_step_with_state(loss_fn, tx, mesh)
     batch_s = shard_batch({"x": x, "y": y}, mesh)
 
+    # XLA's own flop count for the compiled PER-DEVICE module (fwd+
+    # bwd+optimizer on this device's batch/n shard): the honest
+    # hardware-FLOP-utilization numerator for conv nets, where
+    # hand-counting branch convs invites errors. `step` is already
+    # jitted — lower it directly so the executable (and its cache
+    # entry) is the same one the timing loop runs.
+    step_flops = None
+    try:
+        cost = step.lower(params_s, stats_s, opt_s,
+                          batch_s).compile().cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        step_flops = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass  # cost analysis is best-effort; throughput still reports
+
     for _ in range(warmup):
         params_s, stats_s, opt_s, loss = step(params_s, stats_s, opt_s,
                                               batch_s)
@@ -112,6 +127,16 @@ def measure_rate(model_name: str, n: int, batch: int = 0, iters: int = 20,
         "image_size": image, "iters": iters, "dtype": "bfloat16",
         "step_time_ms": round(1000 * dt / iters, 2),
     }
+    # HFU vs the chip's bf16 peak, only where the device kind is known
+    # (shared table with benchmarks/lm.py). step_flops is PER-DEVICE,
+    # so the denominator is one chip's peak — n cancels.
+    from kungfu_tpu.benchmarks.lm import _BF16_PEAK_BY_KIND
+
+    peak = _BF16_PEAK_BY_KIND.get(jax.devices()[0].device_kind)
+    if step_flops and peak:
+        hfu = step_flops / (dt / iters) / peak
+        meta["hfu_vs_v5e_bf16_peak"] = round(hfu, 4)
+        meta["xla_step_gflops"] = round(step_flops / 1e9, 1)
     return rate, meta
 
 

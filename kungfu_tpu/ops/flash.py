@@ -92,14 +92,23 @@ def _diag_ok(iq, jk, causal, block_q, block_k, window=None):
     return ok
 
 
-def _window_span(window, block, n_blocks):
+def _window_span(window, block_q, block_k, n_blocks):
     """K blocks a q-block can see under a causal sliding window, in
-    block units (exact for block_q == block_k): the narrowed grid's
-    inner extent. None = no narrowing (window absent, or it would not
-    shrink the grid)."""
+    k-block units, for block_q = m * block_k (the causal tiling
+    invariant): first visible k-block of q-block i is
+    i*m - ceil(window/block_k) and the last is i*m + m - 1, both
+    AFFINE in i, so span = m + ceil(window/block_k) and the padded
+    index map stays affine (see _flash_fwd_impl). m > 1 trades masked
+    score area inside the band for fewer per-q-block prologues;
+    measured at T=16k/window=512 the masked area wins (m=2 forward
+    1.445 ms vs m=1's 0.969) so auto never picks m > 1 — the
+    generality exists for window/block mixes where the trade flips.
+    None = no narrowing (window absent, or it would not shrink the
+    grid)."""
     if window is None:
         return None
-    span = (window + block - 1) // block + 1
+    m = block_q // block_k
+    span = m + (window + block_k - 1) // block_k
     return span if span < n_blocks else None
 
 
@@ -118,12 +127,16 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     iq = pl.program_id(1)
     kk = pl.program_id(2)            # window-relative when narrowed
     nk = pl.num_programs(2)
-    # narrowed: K/V are front-padded by span-1 blocks so the index map
-    # stays AFFINE (i, j + kk) — a max() in the map was measured to
-    # defeat Mosaic's DMA prefetch pipelining (~28% slower) — and the
-    # real k-block index is recovered here (< 0 falls in the pad and
-    # is skipped)
-    jk = kk if span is None else iq + kk - (span - 1)
+    # narrowed: K/V are front-padded by span-m blocks (m = bq//bk) so
+    # the index map stays AFFINE (i, j*m + kk) — a max() in the map
+    # was measured to defeat Mosaic's DMA prefetch pipelining (~28%
+    # slower) — and the real k-block index is recovered here (< 0
+    # falls in the pad and is skipped)
+    if span is None:
+        jk = kk
+    else:
+        m_ratio = block_q // block_k
+        jk = iq * m_ratio + kk - (span - m_ratio)
     ok = _diag_ok(iq, jk, causal, block_q, block_k, window)
     if span is not None:
         ok = jnp.logical_and(jk >= 0, ok)
@@ -216,12 +229,14 @@ def flash_attention(
     q attends to keys [q - window, q] (Mistral-style local attention).
     The grid itself narrows to the `span` K blocks a q-block can see
     (K/V and Q/dO are padded so the shifted index maps stay affine), so
-    out-of-window blocks stream no DMA and spend no FLOPs in either
-    direction — O(T * window) compute AND data movement. Measured at
-    T=16k, window=512 on v5e (in-graph A/B vs full causal): training
-    fwd+bwd 4.35x, forward 2.85x (round 3's compute-skip-only form
-    plateaued at 2.3x). Shapes where block_q != block_k keep the
-    compute-skip-only behavior.
+    out-of-window blocks stream no DMA and spend no FLOPs — O(T *
+    window) compute AND data movement. The forward and dq kernels
+    narrow for ANY block_q = m * block_k (the maps stay affine — see
+    `_window_span`); only the dkv kernel requires m == 1 and keeps
+    compute-skip otherwise. Measured at T=16k, window=512 on v5e with
+    the round-5 slope harness (earlier per-call figures were
+    relay-latency artifacts): training fwd+bwd 5.48x, forward 4.54x
+    vs the full-causal auto-block baseline.
     """
     out, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
                              interpret, save_lse=False, window=window)
@@ -312,15 +327,16 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     # out-of-window K/V never streams (round 3 skipped only the
     # COMPUTE via pl.when, leaving the full-causal DMA schedule, and
     # measured 2.3x where FLOP proportionality allows ~8x). K/V are
-    # front-padded by span-1 blocks so the map stays AFFINE (see
-    # _kernel).
-    span = (_window_span(window, block_q, t // block_k)
-            if block_q == block_k and causal else None)
+    # front-padded by span-m blocks (m = bq//bk, affine for any m —
+    # see _window_span) so the map stays AFFINE (see _kernel).
+    span = (_window_span(window, block_q, block_k, t // block_k)
+            if causal else None)
+    m_ratio = block_q // block_k
     kv_j = (lambda i, j, kk: (i, kk, 0)) if span is None else (
-        lambda i, j, kk: (i, j + kk, 0))
+        lambda i, j, kk: (i, j * m_ratio + kk, 0))
     kb_in, vb_in = _bh(k), _bh(v)
     if span is not None:
-        kv_pad = (span - 1) * block_k
+        kv_pad = (span - m_ratio) * block_k
         kb_in = jnp.pad(kb_in, ((0, 0), (kv_pad, 0), (0, 0)))
         vb_in = jnp.pad(vb_in, ((0, 0), (kv_pad, 0), (0, 0)))
     kernel = functools.partial(
@@ -374,7 +390,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     kk = pl.program_id(2)            # window-relative when narrowed
     nk = pl.num_programs(2)
     # affine narrowed indexing over front-padded K/V (see _kernel)
-    jk = kk if span is None else iq + kk - (span - 1)
+    if span is None:
+        jk = kk
+    else:
+        m_ratio = block_q // block_k
+        jk = iq * m_ratio + kk - (span - m_ratio)
     ok = _diag_ok(iq, jk, causal, block_q, block_k, window)
     if span is not None:
         ok = jnp.logical_and(jk >= 0, ok)
@@ -500,18 +520,25 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     lse4 = lse.reshape(b * h, nq, 1, block_q)
     delta4 = delta.reshape(b * h, nq, 1, block_q)
     # same grid narrowing as the forward (see _flash_fwd_impl): only
-    # in-window K/V (for dq) and Q/dO (for dk/dv) blocks ever stream
-    span = (_window_span(window, block_q, nk)
-            if block_q == block_k and causal else None)
+    # in-window K/V (for dq) and Q/dO (for dk/dv) blocks ever stream.
+    # dq narrows for any m = bq//bk (affine, like the forward); the
+    # dkv kernel's q-start index jk // m is NOT affine for m > 1, so
+    # dkv narrows only at m == 1 and otherwise keeps the full grid
+    # with compute-skip.
+    m_ratio = block_q // block_k
+    span = (_window_span(window, block_q, block_k, nk)
+            if causal else None)
+    span_dkv = span if m_ratio == 1 else None
     kv_j = (lambda i, j, kk: (i, kk, 0)) if span is None else (
-        lambda i, j, kk: (i, j + kk, 0))
+        lambda i, j, kk: (i, j * m_ratio + kk, 0))
     kb_in, vb_in = kb, vb
     qb_in, dob_in = qb, dob
     if span is not None:
-        kv_pad = (span - 1) * block_k
+        kv_pad = (span - m_ratio) * block_k
         kb_in = jnp.pad(kb, ((0, 0), (kv_pad, 0), (0, 0)))
         vb_in = jnp.pad(vb, ((0, 0), (kv_pad, 0), (0, 0)))
-        q_pad = (span - 1) * block_q
+    if span_dkv is not None:
+        q_pad = (span_dkv - 1) * block_q
         qb_in = jnp.pad(qb, ((0, 0), (0, q_pad), (0, 0)))
         dob_in = jnp.pad(dob, ((0, 0), (0, q_pad), (0, 0)))
     dq_kernel = functools.partial(
@@ -541,14 +568,16 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
         interpret=interpret,
     )(qb, kb_in, vb_in, dob, lse4, delta4)
 
-    qdo_j = kv_j  # same affine shift: q-blocks [jk, jk+span) mirror
+    # m == 1 only (see span_dkv above): q-blocks [jk, jk+span) mirror
     # the dq kernel's k-blocks [iq-span+1, iq] over the padded arrays
+    qdo_j = (lambda i, j, kk: (i, kk, 0)) if span_dkv is None else (
+        lambda i, j, kk: (i, j + kk, 0))
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, window=window, span=span, nq_total=nq)
+        block_k=block_k, window=window, span=span_dkv, nq_total=nq)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b * h, nk, span if span is not None else nq),
+        grid=(b * h, nk, span_dkv if span_dkv is not None else nq),
         in_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
