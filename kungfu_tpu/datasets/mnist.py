@@ -70,7 +70,11 @@ def load_synthetic_split(
     """MNIST-shaped separable classes (examples/common.py distribution)."""
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, 10, size=n).astype(np.int32)
-    centers = rng.normal(0.5, 0.5, size=(10, 28 * 28))
+    # fixed center stream shared across splits (the split seed drives
+    # only the noise): train/test must describe the SAME classes or
+    # held-out accuracy is chance — see datasets/cifar.py
+    centers = np.random.default_rng(2010).normal(
+        0.5, 0.5, size=(10, 28 * 28))
     x = centers[labels] + rng.normal(0.0, 0.35, size=(n, 28 * 28))
     images = np.clip(x, 0.0, 1.0).astype(np.float32).reshape(n, 28, 28, 1)
     if padded:

@@ -159,3 +159,27 @@ class TestImagenet:
         img = np.full((64, 80, 3), 255, np.uint8)
         out = preprocess(img, size=32, resize_shorter=48, normalize=False)
         assert out.max() <= 1.0 + 1e-6 and out.min() >= 0.0
+
+
+def _nearest_center_accuracy(train, test):
+    centers = np.stack([train.images[train.labels == c].mean(
+        axis=0).ravel() for c in range(10)])
+    flat = test.images.reshape(len(test.images), -1)
+    d = ((flat[:, None, :] - centers[None]) ** 2).sum(-1)
+    return (d.argmin(1) == test.labels).mean()
+
+
+def test_synthetic_splits_share_class_structure():
+    """Train (seed 0) and test (seed 1) synthetic splits must describe
+    the SAME classes: a nearest-class-center classifier fit on train
+    centers must beat 90% on the test split. (Round-5 regression: the
+    split seed used to also draw the class centers, capping held-out
+    accuracy at chance.)"""
+    from kungfu_tpu.datasets import Cifar10Loader
+    from kungfu_tpu.datasets.mnist import load_synthetic_split
+
+    sets = Cifar10Loader("").load_datasets()
+    assert _nearest_center_accuracy(sets.train, sets.test) > 0.9
+    mtr = load_synthetic_split(2048, 0)
+    mte = load_synthetic_split(512, 1)
+    assert _nearest_center_accuracy(mtr, mte) > 0.9
