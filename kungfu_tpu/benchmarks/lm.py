@@ -165,14 +165,14 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
 
     def one(params, opt, tokens):
         out = step(params, opt, tokens)
-        return out[0], out[1], out[2]  # params, opt, loss
+        return out[0], out[1], out[2], (out[3] if len(out) > 3 else None)
 
     for _ in range(max(warmup, 1)):
-        params, opt, loss = one(params, opt, tokens)
+        params, opt, loss, aux = one(params, opt, tokens)
     float(loss)  # fence: async dispatch must drain before timing
     t0 = time.perf_counter()
     for _ in range(iters):
-        params, opt, loss = one(params, opt, tokens)
+        params, opt, loss, aux = one(params, opt, tokens)
     float(loss)
     dt = (time.perf_counter() - t0) / iters
     global_tokens = batch * d_data * seq
@@ -213,6 +213,11 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
             cfg, batch * d_data, seq)
         meta["loss_includes_router_aux"] = True
         meta["moe_param_dtype"] = "bfloat16" if moe_bf16 else "float32"
+        if aux is not None and "dropped_frac" in aux:
+            # capacity-overflow tokens dropped in the LAST measured
+            # step — the quality cost of this cf/group configuration
+            meta["moe_dropped_frac"] = round(
+                float(aux["dropped_frac"]), 4)
     return global_tokens / dt, meta
 
 

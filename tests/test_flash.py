@@ -308,3 +308,35 @@ def test_ulysses_flash_grads_match_plain():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=0, atol=2e-4 * scale,
                                    err_msg=name)
+
+
+def test_windowed_narrowing_generalizes_to_rect_blocks():
+    """block_q = m*block_k with a sliding window: the round-5 affine
+    narrowing (span = m + ceil(w/bk), K/V front-padded by span-m
+    blocks) must match the plain masked reference in fwd AND grads for
+    m in {1, 2, 4}, including a window that doesn't divide block_k."""
+    from kungfu_tpu.ops.flash import flash_attention
+    from kungfu_tpu.parallel.sequence import _local_attention
+
+    b, t, h, d = 1, 2048, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(7), 4)
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, t, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, t, h, d), jnp.float32)
+    ct = jax.random.normal(ks[3], (b, t, h, d), jnp.float32)
+    for window in (256, 300):
+        ref, ref_vjp = jax.vjp(
+            lambda q, k, v: _local_attention(
+                q, k, v, causal=True, scale=d ** -0.5, window=window),
+            q, k, v)
+        ref_g = ref_vjp(ct)
+        for bq, bk in ((256, 256), (512, 256), (1024, 256)):
+            got, got_vjp = jax.vjp(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal=True, window=window,
+                    block_q=bq, block_k=bk), q, k, v)
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(ref), atol=2e-2)
+            for a, r in zip(got_vjp(ct), ref_g):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                           atol=3e-2)
