@@ -1,0 +1,41 @@
+"""JAX version compatibility shims.
+
+The codebase targets modern JAX (`jax.shard_map`, whose replication
+check keyword is `check_vma`); the pinned toolchain in some build
+images ships 0.4.x, where the API lives at
+`jax.experimental.shard_map.shard_map` and the keyword is `check_rep`.
+Importing this module installs a `jax.shard_map` attribute when it is
+absent, translating the keyword — so `from jax import shard_map`
+works identically on both toolchains.
+
+Kept OUT of `kungfu_tpu/__init__.py` (and the `benchmarks` package
+init, whose kfrun-spawned allreduce workers are deliberately
+numpy-only) on purpose: the control-plane path must stay jax-free at
+import time, so this shim is imported by `parallel/__init__.py`, the
+jax-facing benchmark/example entry points that touch `jax.shard_map`
+before importing `parallel`, and the test conftest instead.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f, /, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, *args, **kwargs)
+
+    jax.shard_map = _compat_shard_map
+
+if not hasattr(jax.lax, "axis_size"):
+    # modern lax.axis_size(name) returns the STATIC bound-axis size;
+    # on 0.4.x the same information lives in the core axis env
+    from jax._src.core import get_axis_env as _get_axis_env
+
+    def _compat_axis_size(axis_name, /):
+        return _get_axis_env().axis_size(axis_name)
+
+    jax.lax.axis_size = _compat_axis_size

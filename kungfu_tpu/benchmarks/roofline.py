@@ -155,12 +155,16 @@ def build_resnet_step():
 
 def measure_achieved_bandwidth(gib: float = 0.5, iters: int = 20):
     """Sustained HBM GB/s of a pure f32 streaming add (2 reads + 1
-    write) — kept as the round-4 comparable number.
+    write).
 
-    The `iters` additions are CHAINED INSIDE one jit (fori_loop with a
-    data dependency): on a relayed backend (axon) every host-side
-    fence costs ~100 ms of round-trip latency, so per-iteration
-    fencing would understate bandwidth ~50x."""
+    Round 5 switched the timing method to the SLOPE-timed suite
+    (`measure_bandwidth_suite`: t(k_hi) - t(k_lo) over the iteration
+    delta), which by construction cancels the relayed backend's
+    ~100 ms round-trip. Round-4 figures used a single-fence chained
+    run that folded that relay RTT into the rate, so they UNDERSTATE
+    bandwidth and are not comparable to what this now returns — the
+    published round-5 reconciliation (docs/benchmarks.md) retired
+    them."""
     return measure_bandwidth_suite(gib, iters, patterns=("f32_add",)
                                    )["f32_add"]
 

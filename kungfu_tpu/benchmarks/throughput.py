@@ -132,7 +132,11 @@ def measure_rate(model_name: str, n: int, batch: int = 0, iters: int = 20,
     # so the denominator is one chip's peak — n cancels.
     from kungfu_tpu.benchmarks.lm import _BF16_PEAK_BY_KIND
 
-    peak = _BF16_PEAK_BY_KIND.get(jax.devices()[0].device_kind)
+    # the 'v5e' in the key name is historical (the first hardware the
+    # row was published on); the denominator is the peak looked up for
+    # device_kind below, recorded alongside so rows self-describe.
+    meta["device_kind"] = jax.devices()[0].device_kind
+    peak = _BF16_PEAK_BY_KIND.get(meta["device_kind"])
     if step_flops and peak:
         hfu = step_flops / (dt / iters) / peak
         meta["hfu_vs_v5e_bf16_peak"] = round(hfu, 4)

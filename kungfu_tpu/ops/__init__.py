@@ -41,7 +41,13 @@ from .topology import (
     round_robin,
 )
 
-__all__ = [  # flash_attention is exported lazily (see __getattr__)
+__all__ = [
+    # the flash_* names resolve lazily via __getattr__; listing them
+    # here keeps star-import/dir() discoverability at the documented
+    # cost that `import *` (only) eagerly pays the Pallas import
+    "flash_attention",
+    "flash_plan",
+    "flash_attention_flops",
     "all_reduce",
     "all_reduce_mean",
     "group_all_reduce",
@@ -76,9 +82,10 @@ __all__ = [  # flash_attention is exported lazily (see __getattr__)
 def __getattr__(name):
     # lazy: flash pulls in jax.experimental.pallas (+ the Mosaic stack),
     # which baseline collective/optimizer users should not pay for
-    if name == "flash_attention":
-        from .flash import flash_attention
+    if name in ("flash_attention", "flash_plan", "flash_attention_flops"):
+        from . import flash
 
-        globals()[name] = flash_attention  # cache: next lookup is direct
-        return flash_attention
+        attr = getattr(flash, name)
+        globals()[name] = attr  # cache: next lookup is direct
+        return attr
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -6,9 +6,38 @@ blocks through VMEM with online-softmax accumulation so HBM traffic is
 O(T) per query block (FlashAttention, Dao et al. 2022 — on TPU the
 win is HBM bandwidth, the usual bottleneck, not SRAM reuse).
 
-Grid: one program per (batch*head, query-block). Each program keeps its
-Q block, the running max/denominator and the output accumulator in
-VMEM/registers and loops over K/V blocks with `lax.fori_loop`.
+Two execution schemes per kernel (fwd / dq / dkv), selected by a
+VMEM-budget estimate in the style of `ops/fused_ce.py:_pick_blocks`
+(`flash_plan` shows the decision for a shape):
+
+- **resident** (preferred whenever the estimate fits `_VMEM_BUDGET`):
+  grid (B*H, outer-block); the streamed side (K/V for fwd/dq, Q/dO for
+  dkv) is held in VMEM at FULL length per head and the kernel loops
+  over its blocks with a `lax.fori_loop` whose bounds come from
+  `_k_span`/`_q_span` — for causal and windowed attention the trip
+  count genuinely shrinks per program (causal visits the lower
+  triangle only, ~half the blocks; windows visit O(window) blocks),
+  and no fully-masked block is ever visited, in ALL of fwd, dq and
+  dkv. As a bonus the resident side is DMA'd once per head instead of
+  once per outer block (the streaming grid re-fetches every K/V block
+  nq times).
+- **stream** (fallback past the VMEM budget — long T, big D): the
+  round-5 grid (B*H, outer, inner) with VMEM-scratch-carried online
+  state. Causal masking skips compute via `pl.when`; sliding windows
+  narrow the inner grid dim itself (`_window_span`, affine
+  front-padded index maps).
+
+Auto block sizes are budget-driven too: the largest measured-fastest
+power-of-two tile that keeps the worst kernel's VMEM estimate under
+budget (big head dims shrink blocks instead of failing to compile).
+
+Backward overhead trims (round 6): the delta precompute
+(`rowsum(dO * O)`, FlashAttention-2 eq. 4) is folded into the dq
+kernel's first pass — dq already streams dO, so the separate XLA
+reduction and its extra full read of dO/O are gone; dq emits the
+per-row delta for the dkv kernel to consume. Residuals stay at the
+input dtype end to end (bf16 in, bf16 residuals; only the [B*H, T]
+lse/delta row vectors are f32).
 
 `flash_attention` falls back to the plain jnp implementation when
 shapes don't tile (T % block != 0) or on backends without Mosaic
@@ -18,6 +47,7 @@ shapes don't tile (T % block != 0) or on backends without Mosaic
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +56,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+# Mosaic's scoped-vmem stack limit is 16 MB; 15 MB leaves scheduling
+# headroom (same calibration rationale as ops/fused_ce.py). The
+# estimates below are tuned so the round-5 measured-fastest config
+# (1024x1024 blocks at d=64) still fits — the budget bites only where
+# the real limit would (large T residency, large head dims).
+_VMEM_BUDGET = 15 * 1024 * 1024
+
+# test/bench escape hatch: force "stream" or "resident" regardless of
+# the budget decision (unset = auto). Read at trace time so tests can
+# monkeypatch the module attribute.
+_FORCE_SCHEME = os.environ.get("KUNGFU_FLASH_SCHEME") or None
 
 
 def _scores(q_blk, k_blk, iq, jk, *, scale, causal, block_q, block_k,
@@ -79,6 +121,76 @@ def _scores(q_blk, k_blk, iq, jk, *, scale, causal, block_q, block_k,
     return s
 
 
+def _fwd_step(q_blk, k_blk, v_blk, iq, jk, acc, m, l, *, scale, causal,
+              block_q, block_k, window=None):
+    """One K/V block's online-softmax update — the SINGLE definition of
+    the forward recurrence, shared by the resident kernel (fori carry)
+    and the streaming kernel (VMEM-scratch state) so the two schemes
+    cannot drift numerically (the `_scores` discipline, applied to the
+    whole block update). State shapes: acc [bq, d] f32, m/l [bq] f32.
+    Returns the updated (acc, m, l)."""
+    s = _scores(q_blk, k_blk, iq, jk, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, window=window)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l = l * alpha + jnp.sum(p, axis=-1)
+    acc = acc * alpha[:, None] + jax.lax.dot_general(
+        p, v_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc, m_new, l
+
+
+def _fwd_finish(acc, m, l, dtype, save_lse):
+    """(o_block, lse_row | None) from the final online-softmax state —
+    l == 0 (a fully-masked row, only reachable on the streaming grid's
+    padded steps) divides by 1 instead."""
+    l = jnp.where(l == 0.0, 1.0, l)
+    o = (acc / l[:, None]).astype(dtype)
+    return o, ((m + jnp.log(l)) if save_lse else None)
+
+
+def _dq_step(q_blk, k_blk, v_blk, do, lse_col, delta_col, iq, jk, *,
+             scale, causal, block_q, block_k, window=None):
+    """One K/V block's dq contribution (FlashAttention-2: p rebuilt
+    from lse; ds = p * (dp - delta); returns scale * ds @ k) — shared
+    by both backward-dq schemes."""
+    do = do.astype(jnp.float32)
+    s = _scores(q_blk, k_blk, iq, jk, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, window=window)
+    p = jnp.exp(s - lse_col)
+    dp = jax.lax.dot_general(
+        do, v_blk.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_col)
+    return scale * jax.lax.dot_general(
+        ds, k_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _dkv_step(q_blk, k_blk, v_blk, do, lse_row, delta_row, iq, jk, *,
+              scale, causal, block_q, block_k, window=None):
+    """One Q/dO block's (dk, dv) contribution in TRANSPOSED score
+    space (q on lanes — see `_scores`): dv = pT @ do,
+    dk = scale * dsT @ q — shared by both backward-dkv schemes."""
+    do = do.astype(jnp.float32)
+    s_t = _scores(q_blk, k_blk, iq, jk, scale=scale, causal=causal,
+                  block_q=block_q, block_k=block_k, window=window,
+                  transpose=True)                     # [bk, bq]
+    p_t = jnp.exp(s_t - lse_row)
+    dv = jax.lax.dot_general(
+        p_t, do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # p^T @ do
+    dp_t = jax.lax.dot_general(
+        v_blk.astype(jnp.float32), do, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (do @ v^T)^T
+    ds_t = p_t * (dp_t - delta_row)
+    dk = scale * jax.lax.dot_general(
+        ds_t, q_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # ds^T @ q
+    return dk, dv
+
+
 def _diag_ok(iq, jk, causal, block_q, block_k, window=None):
     """False for blocks with no visible entries: causal K blocks
     entirely above the diagonal, and (with a sliding window) K blocks
@@ -90,6 +202,28 @@ def _diag_ok(iq, jk, causal, block_q, block_k, window=None):
         win_ok = jk * block_k + block_k - 1 >= iq * block_q - window
         ok = win_ok if ok is True else jnp.logical_and(ok, win_ok)
     return ok
+
+
+def _span_step(iq, kk, *, span, causal, block_q, block_k, window):
+    """Streaming-scheme inner-step gate, shared by `_kernel` and
+    `_bwd_dq_kernel` (the single definition of which narrowed steps
+    are real, so forward and dq cannot diverge on the visible set):
+    recovers the real k-block index from the window-relative grid
+    index over the front-padded K/V — affine, `jk = iq*m + kk -
+    (span - m)`; a max() in the index map instead was measured to
+    defeat Mosaic's DMA prefetch pipelining (~28% slower) — and
+    returns (jk, ok) where ok is False for steps with no visible
+    entries (above the causal diagonal, past the window, or in the
+    jk < 0 pad)."""
+    if span is None:
+        jk = kk
+    else:
+        m_ratio = block_q // block_k
+        jk = iq * m_ratio + kk - (span - m_ratio)
+    ok = _diag_ok(iq, jk, causal, block_q, block_k, window)
+    if span is not None:
+        ok = jnp.logical_and(jk >= 0, ok)
+    return jk, ok
 
 
 def _window_span(window, block_q, block_k, n_blocks):
@@ -112,6 +246,132 @@ def _window_span(window, block_q, block_k, n_blocks):
     return span if span < n_blocks else None
 
 
+# ---------------------------------------------------------------------------
+# block-skip loop bounds (resident scheme)
+#
+# Shared by the resident kernels AND the structural trip-count tests
+# (`tests/test_flash_skip.py`): the fori_loop trip count of every
+# program IS `hi - lo`, so pinning these functions pins the work-skip
+# behaviour of all five loop nests (fwd/dq over k-blocks, dkv over
+# q-blocks, causal and windowed).
+# ---------------------------------------------------------------------------
+
+
+def _k_span(iq, nk, *, causal, window, block_q, block_k):
+    """Half-open range [lo, hi) of k-blocks with >= 1 visible entry for
+    q-block `iq` — the fwd/dq resident loop bounds. Works on python
+    ints (tests, planning) and traced values (inside kernels) alike.
+    Causal: hi stops at the diagonal block (~halves the total visited
+    blocks); a sliding window additionally lifts lo to the oldest
+    in-window block, making the visit count O(window / block_k)."""
+    if not causal:
+        return 0, nk
+    hi = jnp.minimum(((iq + 1) * block_q - 1) // block_k + 1, nk)
+    if window is None:
+        return 0, hi
+    lo = jnp.maximum((iq * block_q - window) // block_k, 0)
+    return lo, hi
+
+
+def _q_span(jk, nq, *, causal, window, block_q, block_k):
+    """Half-open range [lo, hi) of q-blocks that can see k-block `jk` —
+    the dkv resident loop bounds (mirror image of `_k_span`). Causal:
+    lo starts at the diagonal block; a window caps hi at the newest
+    q-block still within `window` of this block's NEWEST key
+    (jk*block_k + block_k - 1) — the newest key reaches furthest, so
+    it defines the last visible q-block."""
+    if not causal:
+        return 0, nq
+    lo = (jk * block_k) // block_q
+    if window is None:
+        return lo, nq
+    hi = jnp.minimum((jk * block_k + block_k - 1 + window) // block_q + 1,
+                     nq)
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# VMEM-budget estimates (style of ops/fused_ce.py:_pick_blocks)
+#
+# Per-kernel resident-VMEM models: double-buffered pipeline blocks +
+# f32 accumulator state + the [bq, bk] f32 score/probability
+# temporaries (2 for the forward's s/p, 3 for the backwards' s/p +
+# dp/ds). `t` terms are the full-length arrays the resident scheme
+# holds per head; the budget is what flips a shape back to streaming.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_stream_vmem(bq, bk, d, isz):
+    inputs = 2 * (bq * d * isz + 2 * bk * d * isz)
+    outputs = 2 * (bq * d * isz + bq * 4)
+    scratch = bq * d * 4 + 2 * bq * 4
+    return inputs + outputs + scratch + 2 * bq * bk * 4
+
+
+def _dq_stream_vmem(bq, bk, d, isz):
+    inputs = 2 * (3 * bq * d * isz + 2 * bk * d * isz + 2 * bq * 4)
+    outputs = 2 * (bq * d * isz + bq * 4)
+    scratch = bq * d * 4 + 2 * bq * 4
+    return inputs + outputs + scratch + 3 * bq * bk * 4
+
+
+def _dkv_stream_vmem(bq, bk, d, isz, t):
+    inputs = 2 * (2 * bk * d * isz + 2 * bq * d * isz + 2 * t * 4)
+    outputs = 2 * (2 * bk * d * isz)
+    scratch = 2 * bk * d * 4
+    return inputs + outputs + scratch + 3 * bq * bk * 4
+
+
+def _fwd_res_vmem(bq, bk, d, isz, t):
+    inputs = 2 * (bq * d * isz + 2 * t * d * isz)
+    outputs = 2 * (bq * d * isz + bq * 4)
+    carry = bq * d * 4 + 2 * bq * 4
+    return inputs + outputs + carry + 2 * bq * bk * 4
+
+
+def _dq_res_vmem(bq, bk, d, isz, t):
+    inputs = 2 * (3 * bq * d * isz + 2 * t * d * isz + bq * 4)
+    outputs = 2 * (bq * d * isz + bq * 4)
+    carry = bq * d * 4
+    return inputs + outputs + carry + 3 * bq * bk * 4
+
+
+def _dkv_res_vmem(bq, bk, d, isz, t):
+    inputs = 2 * (2 * bk * d * isz + 2 * t * d * isz + 2 * t * 4)
+    outputs = 2 * (2 * bk * d * isz)
+    carry = 2 * bk * d * 4
+    return inputs + outputs + carry + 3 * bq * bk * 4
+
+
+_RES_VMEM = {"fwd": _fwd_res_vmem, "dq": _dq_res_vmem,
+             "dkv": _dkv_res_vmem}
+
+
+def _choose_scheme(which, t, d, isz, bq, bk):
+    """'resident' when the full-length-per-head scheme fits the VMEM
+    budget (it both skips masked blocks AND fetches the streamed side
+    once per head), else 'stream'. `_FORCE_SCHEME` overrides for
+    benchmarking/tests."""
+    if _FORCE_SCHEME in ("stream", "resident"):
+        return _FORCE_SCHEME
+    est = _RES_VMEM[which](bq, bk, d, isz, t)
+    return "resident" if est <= _VMEM_BUDGET else "stream"
+
+
+def _dim_semantics(n):
+    """Pipelining hint: every grid dim is embarrassingly parallel
+    except a streaming kernel's innermost (scratch-carried online
+    state ⇒ sequential)."""
+    sem = ("parallel",) * n if n == 2 else (
+        ("parallel",) * (n - 1) + ("arbitrary",))
+    return pltpu.TPUCompilerParams(dimension_semantics=sem)
+
+
+# ---------------------------------------------------------------------------
+# streaming kernels (grid (B*H, outer, inner), VMEM-scratch state)
+# ---------------------------------------------------------------------------
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             scale, causal, block_q, block_k, window=None, span=None):
     """Grid (B*H, nq, nk), nk innermost: the VMEM scratch (accumulator +
@@ -127,19 +387,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     iq = pl.program_id(1)
     kk = pl.program_id(2)            # window-relative when narrowed
     nk = pl.num_programs(2)
-    # narrowed: K/V are front-padded by span-m blocks (m = bq//bk) so
-    # the index map stays AFFINE (i, j*m + kk) — a max() in the map
-    # was measured to defeat Mosaic's DMA prefetch pipelining (~28%
-    # slower) — and the real k-block index is recovered here (< 0
-    # falls in the pad and is skipped)
-    if span is None:
-        jk = kk
-    else:
-        m_ratio = block_q // block_k
-        jk = iq * m_ratio + kk - (span - m_ratio)
-    ok = _diag_ok(iq, jk, causal, block_q, block_k, window)
-    if span is not None:
-        ok = jnp.logical_and(jk >= 0, ok)
+    jk, ok = _span_step(iq, kk, span=span, causal=causal,
+                        block_q=block_q, block_k=block_k, window=window)
 
     @pl.when(kk == 0)
     def _():
@@ -149,25 +398,19 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ok)
     def _():
-        s = _scores(q_ref[0], k_ref[0], iq, jk, scale=scale,
-                    causal=causal, block_q=block_q, block_k=block_k,
-                    window=window)
-        v_blk = v_ref[0].astype(jnp.float32)
-        m = m_ref[:, 0]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
-        m_ref[:, 0] = m_new
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc, m, l = _fwd_step(
+            q_ref[0], k_ref[0], v_ref[0], iq, jk, acc_ref[:],
+            m_ref[:, 0], l_ref[:, 0], scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, window=window)
+        acc_ref[:] = acc
+        m_ref[:, 0] = m
+        l_ref[:, 0] = l
 
     @pl.when(kk == nk - 1)
     def _():
-        l = l_ref[:, 0]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        o, lse = _fwd_finish(acc_ref[:], m_ref[:, 0], l_ref[:, 0],
+                             o_ref.dtype, lse_ref is not None)
+        o_ref[0] = o
         if lse_ref is not None:
             # per-row logsumexp of the scaled scores — the backward
             # kernels reconstruct p = exp(s - lse) from it instead of
@@ -175,8 +418,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             # the one sublane->lane relayout here runs once per
             # q-block, not per inner step. Skipped entirely on the
             # no-grad forward (save_lse=False).
-            lse_ref[0, 0] = (m_ref[:, 0] + jnp.log(l)).reshape(
-                1, block_q)
+            lse_ref[0, 0] = lse.reshape(1, block_q)
 
 
 def _kernel_nolse(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
@@ -185,6 +427,118 @@ def _kernel_nolse(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     _kernel(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref, l_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             window=window, span=span)
+
+
+# ---------------------------------------------------------------------------
+# resident kernels (grid (B*H, outer), dynamic-trip-count inner fori)
+# ---------------------------------------------------------------------------
+
+
+def _fwd_res_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                    causal, block_q, block_k, window=None, nk=None):
+    """Grid (B*H, nq): K/V live in VMEM at full length per head (one
+    O(T)-per-head DMA, vs the streaming grid re-fetching each K/V
+    block nq times); the online-softmax state is a fori_loop carry (no
+    cross-step scratch), and the loop runs ONLY over `_k_span`'s
+    visible k-blocks — causal programs stop at the diagonal, windowed
+    programs start at the window edge, so fully-masked blocks spend no
+    compute (their bytes still ride the full-length fetch)."""
+    iq = pl.program_id(1)
+    q_blk = q_ref[0]
+    d = q_blk.shape[-1]
+    lo, hi = _k_span(iq, nk, causal=causal, window=window,
+                     block_q=block_q, block_k=block_k)
+
+    def body(jk, carry):
+        off = pl.multiple_of(jk * block_k, block_k)
+        return _fwd_step(
+            q_blk, k_ref[0, pl.ds(off, block_k), :],
+            v_ref[0, pl.ds(off, block_k), :], iq, jk, *carry,
+            scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, window=window)
+
+    acc, m, l = lax.fori_loop(lo, hi, body, (
+        jnp.zeros((block_q, d), jnp.float32),
+        jnp.full((block_q,), NEG_INF, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32)))
+    o, lse = _fwd_finish(acc, m, l, o_ref.dtype, lse_ref is not None)
+    o_ref[0] = o
+    if lse_ref is not None:
+        lse_ref[0, 0] = lse.reshape(1, block_q)
+
+
+def _fwd_res_kernel_nolse(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                          block_q, block_k, window=None, nk=None):
+    _fwd_res_kernel(q_ref, k_ref, v_ref, o_ref, None, scale=scale,
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    window=window, nk=nk)
+
+
+def _dq_res_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+                   delta_ref, *, scale, causal, block_q, block_k,
+                   window=None, nk=None):
+    """Grid (B*H, nq): dq for one Q block against VMEM-resident K/V,
+    visiting only `_k_span`'s visible k-blocks. The delta precompute
+    (rowsum(dO * O), FlashAttention-2 eq. 4) is folded into this
+    kernel's prologue — dO and O are already here as q-blocks, so the
+    standalone XLA reduction (and its extra HBM pass over both) is
+    gone; the lane-major delta row is emitted for the dkv kernel."""
+    iq = pl.program_id(1)
+    q_blk = q_ref[0]
+    d = q_blk.shape[-1]
+    do = do_ref[0].astype(jnp.float32)
+    delta_col = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
+                        keepdims=True)                    # [bq, 1]
+    delta_ref[0, 0] = delta_col.reshape(1, block_q)
+    lse_col = lse_ref[0, 0].reshape(block_q, 1)
+    lo, hi = _k_span(iq, nk, causal=causal, window=window,
+                     block_q=block_q, block_k=block_k)
+
+    def body(jk, acc):
+        off = pl.multiple_of(jk * block_k, block_k)
+        return acc + _dq_step(
+            q_blk, k_ref[0, pl.ds(off, block_k), :],
+            v_ref[0, pl.ds(off, block_k), :], do, lse_col, delta_col,
+            iq, jk, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, window=window)
+
+    acc = lax.fori_loop(lo, hi, body,
+                        jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _dkv_res_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    window=None, nq=None):
+    """Grid (B*H, nk): dk/dv for one K/V block against VMEM-resident
+    Q/dO, in TRANSPOSED score space (q on lanes — see `_scores`),
+    visiting only `_q_span`'s visible q-blocks: causal programs start
+    at the diagonal, windowed programs stop at the window edge.
+    lse/delta arrive as the head's full lane-major row set, DMA'd once
+    per head; the per-q-block row is a cheap non-tiled-dim select."""
+    jk = pl.program_id(1)
+    k_blk = k_ref[0]
+    d = k_blk.shape[-1]
+    lo, hi = _q_span(jk, nq, causal=causal, window=window,
+                     block_q=block_q, block_k=block_k)
+
+    def body(iq, carry):
+        dk_acc, dv_acc = carry
+        off = pl.multiple_of(iq * block_q, block_q)
+        dk, dv = _dkv_step(
+            q_ref[0, pl.ds(off, block_q), :], k_blk, v_ref[0],
+            do_ref[0, pl.ds(off, block_q), :],
+            lse_ref[0, iq, 0, :][None, :],    # [1, bq] lane rows
+            delta_ref[0, iq, 0, :][None, :],
+            iq, jk, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, window=window)
+        return dk_acc + dk, dv_acc + dv
+
+    dk_acc, dv_acc = lax.fori_loop(lo, hi, body, (
+        jnp.zeros((block_k, d), jnp.float32),
+        jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
 def _plain_attention(q, k, v, causal, scale, window=None):
@@ -212,38 +566,45 @@ def flash_attention(
 
     Tiling requires T % block == 0 (and causal additionally
     block_q % block_k == 0); other shapes use the plain implementation.
-    `block_q`/`block_k` default to auto: T <= 512 runs as ONE block
+    `block_q`/`block_k` default to auto: T <= 1024 runs as ONE block
     (any length — full-dim blocks always satisfy Mosaic's tiling rule;
     odd lengths verified on real v5e), longer T picks the largest of
-    512/256/128 dividing it (512 fastest measured on v5e), and longer
-    non-dividing T takes the plain fallback. `interpret=None`
+    1024/512/256/128 dividing it (1024 fastest measured on v5e) that
+    also keeps every kernel's VMEM estimate under `_VMEM_BUDGET`
+    (large head dims shrink blocks instead of compile-OOMing), and
+    longer non-dividing T takes the plain fallback. `interpret=None`
     auto-selects interpreter mode off-TPU so tests run on the CPU mesh.
 
+    Each kernel then runs the VMEM-resident block-skipping scheme when
+    it fits the budget, else the streaming grid — see the module
+    docstring and `flash_plan` for the decision and the per-shape
+    visited-block counts.
+
     Backward pass: fused flash backward kernels — the forward saves only
-    (q, k, v, o, lse), and dq/dk/dv are computed blockwise with the
+    (q, k, v, o, lse), dq/dk/dv are computed blockwise with the
     FlashAttention-2 recurrence (p re-materialized per block from the
-    saved logsumexp), so both directions are O(T) in HBM. Non-tiling
-    shapes fall back to the plain VJP.
+    saved logsumexp), and the delta precompute rides inside the dq
+    kernel, so both directions are O(T) in HBM with no standalone
+    reduction pass. Non-tiling shapes fall back to the plain VJP.
 
     `window` (requires causal=True): sliding-window attention — position
     q attends to keys [q - window, q] (Mistral-style local attention).
-    The grid itself narrows to the `span` K blocks a q-block can see
-    (K/V and Q/dO are padded so the shifted index maps stay affine), so
-    out-of-window blocks stream no DMA and spend no FLOPs — O(T *
-    window) compute AND data movement. The forward and dq kernels
-    narrow for ANY block_q = m * block_k (the maps stay affine — see
-    `_window_span`); only the dkv kernel requires m == 1 and keeps
-    compute-skip otherwise. Measured at T=16k, window=512 on v5e with
-    the round-5 slope harness (earlier per-call figures were
-    relay-latency artifacts): training fwd+bwd 5.48x, forward 4.54x
-    vs the full-causal auto-block baseline.
+    Out-of-window blocks stream no DMA and spend no FLOPs — O(T *
+    window) compute AND data movement — via the resident loop bounds
+    (`_k_span`/`_q_span`), or, on the streaming fallback, via the
+    narrowed inner grid (`_window_span`; the streaming dkv narrows only
+    at block_q == block_k and keeps compute-skip otherwise). Measured
+    at T=16k, window=512 on v5e with the round-5 slope harness:
+    training fwd+bwd 5.48x, forward 4.54x vs the full-causal
+    auto-block baseline.
     """
     out, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
                              interpret, save_lse=False, window=window)
     return out
 
 
-def _tiles(t, causal, block_q, block_k, window=None):
+def _tiles(t, causal, block_q, block_k, window=None, *, d=None,
+           itemsize=4):
     """The (block_q, block_k) actually usable for length t, or None.
 
     `None` block sizes auto-select the largest power-of-two <= 1024
@@ -253,15 +614,21 @@ def _tiles(t, causal, block_q, block_k, window=None):
     previously beaten 128 by ~25%. With a sliding `window`, the cap is
     the largest power-of-two <= window instead: past-window score area
     inside a block is masked waste, and at t=16k/window=512 the 1024
-    block measured 40% SLOWER (7.04 vs 5.02 ms) than 512. Explicit
-    sizes are respected as given; mixing one explicit size with auto
-    fills the other with the SAME value so the causal divisibility
-    invariant can't silently demote the call to plain attention. Tiles
-    below 128 starve the MXU, so auto only goes smaller when one block
-    covers the whole (short) sequence; otherwise non-tiling lengths
-    take the plain fallback as before.
+    block measured 40% SLOWER (7.04 vs 5.02 ms) than 512. When the
+    head dim `d` is known, auto blocks additionally shrink (bk first,
+    then bq, powers of two, floor 128) until the WORST streaming
+    kernel's VMEM estimate fits `_VMEM_BUDGET` — the fused_ce
+    `_pick_blocks` discipline, so big-D shapes trade tile size for
+    compilability instead of OOMing in Mosaic. Explicit sizes are
+    respected as given (no budget shrink); mixing one explicit size
+    with auto fills the other with the SAME value so the causal
+    divisibility invariant can't silently demote the call to plain
+    attention. Tiles below 128 starve the MXU, so auto only goes
+    smaller when one block covers the whole (short) sequence;
+    otherwise non-tiling lengths take the plain fallback as before.
     """
-    if block_q is None and block_k is None:
+    auto = block_q is None and block_k is None
+    if auto:
         cap = 1024
         if window is not None:
             cap = max(128, 1 << max(7, (window).bit_length() - 1))
@@ -269,11 +636,11 @@ def _tiles(t, causal, block_q, block_k, window=None):
         if t <= cap:
             block_q = block_k = t  # one block: any length tiles
         else:
-            auto = next((b for b in (1024, 512, 256, 128)
+            pick = next((b for b in (1024, 512, 256, 128)
                          if b <= cap and t % b == 0), None)
-            if auto is None:
+            if pick is None:
                 return None
-            block_q = block_k = auto
+            block_q = block_k = pick
     elif block_q is None:
         block_q = block_k
     elif block_k is None:
@@ -283,7 +650,49 @@ def _tiles(t, causal, block_q, block_k, window=None):
     if (t % block_q or t % block_k
             or (causal and block_q % block_k)):
         return None
+    if auto and d is not None:
+        # budget shrink — auto pow2 blocks only (halving a pow2 divisor
+        # of t keeps dividing t and preserves bq % bk == 0)
+        def _pow2(x):
+            return x & (x - 1) == 0
+
+        def _worst(bq, bk):
+            return max(_fwd_stream_vmem(bq, bk, d, itemsize),
+                       _dq_stream_vmem(bq, bk, d, itemsize),
+                       _dkv_stream_vmem(bq, bk, d, itemsize, t))
+
+        while _worst(block_q, block_k) > _VMEM_BUDGET:
+            if block_k > 128 and _pow2(block_k):
+                block_k //= 2
+            elif block_q > 128 and _pow2(block_q):
+                block_q //= 2
+            else:
+                break
     return block_q, block_k
+
+
+def _narrowed_kv(causal, window, block_q, block_k, nk, kb, vb):
+    """Streaming-scheme sliding-window narrowing, shared by the
+    forward and dq paths (which MUST agree on which blocks stream):
+    returns (span, kv index map, K/V inputs). With a window, the inner
+    grid dim narrows to the `span` K blocks a q-block can see and the
+    K/V index maps shift by the q-block — out-of-window K/V never
+    streams (round 3 skipped only the COMPUTE via pl.when, leaving the
+    full-causal DMA schedule, and measured 2.3x where FLOP
+    proportionality allows ~8x). K/V are front-padded by span-m blocks
+    (m = bq//bk, affine for any m — see `_window_span`) so the map
+    stays AFFINE — a max() in the map was measured to defeat Mosaic's
+    DMA prefetch pipelining (~28% slower; see `_kernel`)."""
+    span = (_window_span(window, block_q, block_k, nk)
+            if causal else None)
+    if span is None:
+        return None, (lambda i, j, kk: (i, kk, 0)), kb, vb
+    m_ratio = block_q // block_k
+    kv_pad = (span - m_ratio) * block_k
+    return (span,
+            lambda i, j, kk: (i, j * m_ratio + kk, 0),
+            jnp.pad(kb, ((0, 0), (kv_pad, 0), (0, 0))),
+            jnp.pad(vb, ((0, 0), (kv_pad, 0), (0, 0))))
 
 
 def _bh(x):
@@ -314,7 +723,9 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     b, t, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    tiles = _tiles(t, causal, block_q, block_k, window)
+    isz = jnp.dtype(q.dtype).itemsize
+    tiles = _tiles(t, causal, block_q, block_k, window, d=d,
+                   itemsize=isz)
     if tiles is None:
         return _plain_attention(q, k, v, causal, scale,
                                 window=window), None
@@ -322,105 +733,106 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    # sliding window: narrow the inner grid dim to the `span` K blocks
-    # a q-block can see and shift the K/V index maps by the q-block —
-    # out-of-window K/V never streams (round 3 skipped only the
-    # COMPUTE via pl.when, leaving the full-causal DMA schedule, and
-    # measured 2.3x where FLOP proportionality allows ~8x). K/V are
-    # front-padded by span-m blocks (m = bq//bk, affine for any m —
-    # see _window_span) so the map stays AFFINE (see _kernel).
-    span = (_window_span(window, block_q, block_k, t // block_k)
-            if causal else None)
-    m_ratio = block_q // block_k
-    kv_j = (lambda i, j, kk: (i, kk, 0)) if span is None else (
-        lambda i, j, kk: (i, j * m_ratio + kk, 0))
-    kb_in, vb_in = _bh(k), _bh(v)
-    if span is not None:
-        kv_pad = (span - m_ratio) * block_k
-        kb_in = jnp.pad(kb_in, ((0, 0), (kv_pad, 0), (0, 0)))
-        vb_in = jnp.pad(vb_in, ((0, 0), (kv_pad, 0), (0, 0)))
-    kernel = functools.partial(
-        _kernel if save_lse else _kernel_nolse, scale=scale,
-        causal=causal, block_q=block_q, block_k=block_k, window=window,
-        span=span)
-    o_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0))
+    nq, nk = t // block_q, t // block_k
     o_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
-    nq = t // block_q
-    lse_spec = pl.BlockSpec((1, 1, 1, block_q),
-                            lambda i, j, kk: (i, j, 0, 0))
     lse_shape = jax.ShapeDtypeStruct((b * h, nq, 1, block_q),
                                      jnp.float32)
-    result = pl.pallas_call(
-        kernel,
-        grid=(b * h, t // block_q,
-              span if span is not None else t // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), kv_j),
-            pl.BlockSpec((1, block_k, d), kv_j),
-        ],
-        out_specs=[o_spec, lse_spec] if save_lse else o_spec,
-        out_shape=[o_shape, lse_shape] if save_lse else o_shape,
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running denominator
-        ],
-        interpret=interpret,
-    )(_bh(q), kb_in, vb_in)
+
+    if _choose_scheme("fwd", t, d, isz, block_q, block_k) == "resident":
+        kernel = functools.partial(
+            _fwd_res_kernel if save_lse else _fwd_res_kernel_nolse,
+            scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, window=window, nk=nk)
+        o_spec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
+        lse_spec = pl.BlockSpec((1, 1, 1, block_q),
+                                lambda i, j: (i, j, 0, 0))
+        result = pl.pallas_call(
+            kernel,
+            grid=(b * h, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=[o_spec, lse_spec] if save_lse else o_spec,
+            out_shape=[o_shape, lse_shape] if save_lse else o_shape,
+            compiler_params=_dim_semantics(2),
+            interpret=interpret,
+        )(_bh(q), _bh(k), _bh(v))
+    else:
+        span, kv_j, kb_in, vb_in = _narrowed_kv(
+            causal, window, block_q, block_k, nk, _bh(k), _bh(v))
+        kernel = functools.partial(
+            _kernel if save_lse else _kernel_nolse, scale=scale,
+            causal=causal, block_q=block_q, block_k=block_k,
+            window=window, span=span)
+        o_spec = pl.BlockSpec((1, block_q, d),
+                              lambda i, j, kk: (i, j, 0))
+        lse_spec = pl.BlockSpec((1, 1, 1, block_q),
+                                lambda i, j, kk: (i, j, 0, 0))
+        result = pl.pallas_call(
+            kernel,
+            grid=(b * h, nq, span if span is not None else nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, kk: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), kv_j),
+                pl.BlockSpec((1, block_k, d), kv_j),
+            ],
+            out_specs=[o_spec, lse_spec] if save_lse else o_spec,
+            out_shape=[o_shape, lse_shape] if save_lse else o_shape,
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),  # out accumulator
+                pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+                pltpu.VMEM((block_q, 1), jnp.float32),  # running denom
+            ],
+            compiler_params=_dim_semantics(3),
+            interpret=interpret,
+        )(_bh(q), kb_in, vb_in)
     if not save_lse:
         return _unbh(result, b, h), None
     out, lse = result
     return _unbh(out, b, h), lse.reshape(b * h, t)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_ref, lse_col, delta_col, *, scale,
-                   causal, block_q, block_k, window=None, span=None):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                   dq_ref, delta_out_ref, acc_ref, lse_col, delta_col,
+                   *, scale, causal, block_q, block_k, window=None,
+                   span=None):
     """Grid (B*H, nq, nk), nk innermost: accumulate dq for one Q block
     while K/V blocks stream by. p is rebuilt from the saved lse, never
-    stored: ds = p * (dp - delta); dq += scale * ds @ k. The q-row
-    lse/delta arrive lane-major (compact [B*H, T] storage) and are
-    relayouted to columns ONCE per q-block into VMEM scratch — this
-    kernel's blocks change only with (i, q-block), so the inner k-sweep
-    reuses the cached columns; its matmuls stay in Mosaic-native NN/NT
-    forms (a fully transposed-space dq variant turns ds @ k into a TN
-    contraction and measured 36% slower end-to-end)."""
+    stored: ds = p * (dp - delta); dq += scale * ds @ k. The q-row lse
+    arrives lane-major (compact [B*H, T] storage) and is relayouted to
+    a column ONCE per q-block into VMEM scratch; delta is COMPUTED here
+    in the kk == 0 prologue (rowsum(dO * O) — dO/O are this program's
+    q-blocks already) and emitted lane-major for the dkv kernel, so no
+    standalone XLA delta pass touches HBM. This kernel's blocks change
+    only with (i, q-block), so the inner k-sweep reuses the cached
+    columns; its matmuls stay in Mosaic-native NN/NT forms (a fully
+    transposed-space dq variant turns ds @ k into a TN contraction and
+    measured 36% slower end-to-end)."""
     iq = pl.program_id(1)
     kk = pl.program_id(2)            # window-relative when narrowed
     nk = pl.num_programs(2)
-    # affine narrowed indexing over front-padded K/V (see _kernel)
-    if span is None:
-        jk = kk
-    else:
-        m_ratio = block_q // block_k
-        jk = iq * m_ratio + kk - (span - m_ratio)
-    ok = _diag_ok(iq, jk, causal, block_q, block_k, window)
-    if span is not None:
-        ok = jnp.logical_and(jk >= 0, ok)
+    jk, ok = _span_step(iq, kk, span=span, causal=causal,
+                        block_q=block_q, block_k=block_k, window=window)
 
     @pl.when(kk == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
         lse_col[:] = lse_ref[0, 0].reshape(block_q, 1)
-        delta_col[:] = delta_ref[0, 0].reshape(block_q, 1)
+        delta_col[:] = jnp.sum(
+            do_ref[0].astype(jnp.float32)
+            * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
+        delta_out_ref[0, 0] = delta_col[:].reshape(1, block_q)
 
     @pl.when(ok)
     def _():
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = _scores(q_ref[0], k_ref[0], iq, jk, scale=scale,
-                    causal=causal, block_q=block_q, block_k=block_k,
-                    window=window)
-        p = jnp.exp(s - lse_col[:])
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_col[:])
-        acc_ref[:] += scale * jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_ref[:] += _dq_step(
+            q_ref[0], k_ref[0], v_ref[0],
+            do_ref[0].astype(jnp.float32), lse_col[:], delta_col[:],
+            iq, jk, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, window=window)
 
     @pl.when(kk == nk - 1)
     def _():
@@ -468,25 +880,14 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ok)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s_t = _scores(q_ref[0], k_ref[0], iq, jk, scale=scale,
-                      causal=causal, block_q=block_q, block_k=block_k,
-                      window=window, transpose=True)  # [bk, bq]
-        lse_row = lse_ref[0, iq_c, 0, :][None, :]     # [1, bq] lanes
-        delta_row = delta_ref[0, iq_c, 0, :][None, :]
-        p_t = jnp.exp(s_t - lse_row)
-        dv_acc[:] += jax.lax.dot_general(
-            p_t, do, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)       # p^T @ do
-        dp_t = jax.lax.dot_general(
-            v_blk, do, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (do @ v^T)^T
-        ds_t = p_t * (dp_t - delta_row)
-        dk_acc[:] += scale * jax.lax.dot_general(
-            ds_t, q, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)       # ds^T @ q
+        dk, dv = _dkv_step(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0],
+            lse_ref[0, iq_c, 0, :][None, :],          # [1, bq] lanes
+            delta_ref[0, iq_c, 0, :][None, :],
+            iq, jk, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, window=window)
+        dk_acc[:] += dk
+        dv_acc[:] += dv
 
     @pl.when(kk == nq - 1)
     def _():
@@ -497,111 +898,177 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
                     interpret, window=None):
     b, t, h, d = q.shape
-    block_q, block_k = _tiles(t, causal, block_q, block_k,
-                                window)
+    isz = jnp.dtype(q.dtype).itemsize
+    block_q, block_k = _tiles(t, causal, block_q, block_k, window,
+                              d=d, itemsize=isz)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     qb, kb, vb = _bh(q), _bh(k), _bh(v)
-    dob = _bh(g)
-    # delta_i = rowsum(dO * O): one cheap elementwise pass, shared by
-    # both kernels (FlashAttention-2 eq. 4). lse/delta enter the
-    # kernels at TRUE [B*H, T] size, reshaped to [B*H, nq, 1, block_q]
-    # so Mosaic's tiling rule (trailing block dims equal the array
-    # dims) accepts a one-row block; the dq kernel relayouts the row
-    # into VMEM column scratch once per q-block, the dkv kernel works
-    # in transposed score space where the row is already lane-shaped
-    # (see _scores). This closes the round-2 ADVICE item: the old
-    # layout broadcast both vectors to [B*H, T, 128] f32 in HBM
-    # (~100 MB each at B*H=8, T=32k) and paid 128x-sized DMAs per
-    # backward grid step.
+    dob, ob = _bh(g), _bh(o)
+    # lse enters the kernels at TRUE [B*H, T] size, reshaped to
+    # [B*H, nq, 1, block_q] so Mosaic's tiling rule (trailing block
+    # dims equal the array dims) accepts a one-row block; the dq kernel
+    # relayouts the row into VMEM column scratch once per q-block, the
+    # dkv kernel works in transposed score space where the row is
+    # already lane-shaped (see _scores). delta (rowsum(dO * O),
+    # FlashAttention-2 eq. 4) is no longer precomputed by XLA at all:
+    # the dq kernel folds it into its kk == 0 / loop prologue (dO and O
+    # stream there anyway) and emits it in the same compact lane-major
+    # layout for the dkv kernel. This closes the round-2 ADVICE item
+    # (the old layout broadcast both vectors to [B*H, T, 128] f32 in
+    # HBM) AND the round-5 one (the separate delta reduction paid one
+    # extra full HBM pass over dO and O per backward).
     nq, nk = t // block_q, t // block_k
-    delta = jnp.sum(dob.astype(jnp.float32)
-                    * _bh(o).astype(jnp.float32), axis=-1)  # [BH, T]
     lse4 = lse.reshape(b * h, nq, 1, block_q)
-    delta4 = delta.reshape(b * h, nq, 1, block_q)
-    # same grid narrowing as the forward (see _flash_fwd_impl): only
-    # in-window K/V (for dq) and Q/dO (for dk/dv) blocks ever stream.
-    # dq narrows for any m = bq//bk (affine, like the forward); the
-    # dkv kernel's q-start index jk // m is NOT affine for m > 1, so
-    # dkv narrows only at m == 1 and otherwise keeps the full grid
-    # with compute-skip.
-    m_ratio = block_q // block_k
-    span = (_window_span(window, block_q, block_k, nk)
-            if causal else None)
-    span_dkv = span if m_ratio == 1 else None
-    kv_j = (lambda i, j, kk: (i, kk, 0)) if span is None else (
-        lambda i, j, kk: (i, j * m_ratio + kk, 0))
-    kb_in, vb_in = kb, vb
-    qb_in, dob_in = qb, dob
-    if span is not None:
-        kv_pad = (span - m_ratio) * block_k
-        kb_in = jnp.pad(kb, ((0, 0), (kv_pad, 0), (0, 0)))
-        vb_in = jnp.pad(vb, ((0, 0), (kv_pad, 0), (0, 0)))
-    if span_dkv is not None:
-        q_pad = (span_dkv - 1) * block_q
-        qb_in = jnp.pad(qb, ((0, 0), (0, q_pad), (0, 0)))
-        dob_in = jnp.pad(dob, ((0, 0), (0, q_pad), (0, 0)))
-    dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, window=window, span=span)
-    dq = pl.pallas_call(
-        dq_kernel,
-        grid=(b * h, nq, span if span is not None else nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), kv_j),
-            pl.BlockSpec((1, block_k, d), kv_j),
-            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, 1, 1, block_q),
-                         lambda i, j, kk: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, 1, block_q),
-                         lambda i, j, kk: (i, j, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda i, j, kk: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),  # lse column cache
-            pltpu.VMEM((block_q, 1), jnp.float32),  # delta column cache
-        ],
-        interpret=interpret,
-    )(qb, kb_in, vb_in, dob, lse4, delta4)
+    delta_shape = jax.ShapeDtypeStruct((b * h, nq, 1, block_q),
+                                       jnp.float32)
+    dq_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
 
-    # m == 1 only (see span_dkv above): q-blocks [jk, jk+span) mirror
-    # the dq kernel's k-blocks [iq-span+1, iq] over the padded arrays
-    qdo_j = (lambda i, j, kk: (i, kk, 0)) if span_dkv is None else (
-        lambda i, j, kk: (i, j + kk, 0))
-    dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, window=window, span=span_dkv, nq_total=nq)
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
-        grid=(b * h, nk, span_dkv if span_dkv is not None else nq),
-        in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_q, d), qdo_j),
-            pl.BlockSpec((1, block_q, d), qdo_j),
-            pl.BlockSpec((1, nq, 1, block_q),
-                         lambda i, j, kk: (i, 0, 0, 0)),
-            pl.BlockSpec((1, nq, 1, block_q),
-                         lambda i, j, kk: (i, 0, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
-        interpret=interpret,
-    )(kb, vb, qb_in, dob_in, lse4, delta4)
+    if _choose_scheme("dq", t, d, isz, block_q, block_k) == "resident":
+        dq_kernel = functools.partial(
+            _dq_res_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, window=window, nk=nk)
+        dq, delta4 = pl.pallas_call(
+            dq_kernel,
+            grid=(b * h, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda i, j: (i, j, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda i, j: (i, j, 0, 0)),
+            ],
+            out_shape=[dq_shape, delta_shape],
+            compiler_params=_dim_semantics(2),
+            interpret=interpret,
+        )(qb, kb, vb, dob, ob, lse4)
+    else:
+        # same grid narrowing as the streaming forward — _narrowed_kv
+        # is the single definition, so fwd and dq cannot disagree on
+        # which blocks stream; narrows for any m = bq//bk (affine)
+        span, kv_j, kb_in, vb_in = _narrowed_kv(
+            causal, window, block_q, block_k, nk, kb, vb)
+        dq_kernel = functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, window=window, span=span)
+        dq, delta4 = pl.pallas_call(
+            dq_kernel,
+            grid=(b * h, nq, span if span is not None else nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, kk: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), kv_j),
+                pl.BlockSpec((1, block_k, d), kv_j),
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, kk: (i, j, 0)),
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, kk: (i, j, 0)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda i, j, kk: (i, j, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, kk: (i, j, 0)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda i, j, kk: (i, j, 0, 0)),
+            ],
+            out_shape=[dq_shape, delta_shape],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),  # lse column
+                pltpu.VMEM((block_q, 1), jnp.float32),  # delta column
+            ],
+            compiler_params=_dim_semantics(3),
+            interpret=interpret,
+        )(qb, kb_in, vb_in, dob, ob, lse4)
+
+    dkv_shapes = [
+        jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+        jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+    ]
+    if _choose_scheme("dkv", t, d, isz, block_q, block_k) == "resident":
+        dkv_kernel = functools.partial(
+            _dkv_res_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, window=window, nq=nq)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(b * h, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, nq, 1, block_q),
+                             lambda i, j: (i, 0, 0, 0)),
+                pl.BlockSpec((1, nq, 1, block_q),
+                             lambda i, j: (i, 0, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            ],
+            out_shape=dkv_shapes,
+            compiler_params=_dim_semantics(2),
+            interpret=interpret,
+        )(kb, vb, qb, dob, lse4, delta4)
+    else:
+        # the streaming dkv kernel's q-start index jk // m is NOT
+        # affine for m > 1, so it narrows only at m == 1 and otherwise
+        # keeps the full grid with compute-skip. m == 1: q-blocks
+        # [jk, jk+span) mirror the dq kernel's k-blocks [iq-span+1, iq]
+        # over END-padded Q/dO arrays.
+        m_ratio = block_q // block_k
+        span = (_window_span(window, block_q, block_k, nk)
+                if causal else None)
+        span_dkv = span if m_ratio == 1 else None
+        qb_in, dob_in = qb, dob
+        if span_dkv is not None:
+            q_pad = (span_dkv - 1) * block_q
+            qb_in = jnp.pad(qb, ((0, 0), (0, q_pad), (0, 0)))
+            dob_in = jnp.pad(dob, ((0, 0), (0, q_pad), (0, 0)))
+        qdo_j = (lambda i, j, kk: (i, kk, 0)) if span_dkv is None else (
+            lambda i, j, kk: (i, j + kk, 0))
+        dkv_kernel = functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, window=window,
+            span=span_dkv, nq_total=nq)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(b * h, nk,
+                  span_dkv if span_dkv is not None else nq),
+            in_specs=[
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, kk: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, kk: (i, j, 0)),
+                pl.BlockSpec((1, block_q, d), qdo_j),
+                pl.BlockSpec((1, block_q, d), qdo_j),
+                pl.BlockSpec((1, nq, 1, block_q),
+                             lambda i, j, kk: (i, 0, 0, 0)),
+                pl.BlockSpec((1, nq, 1, block_q),
+                             lambda i, j, kk: (i, 0, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, kk: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, kk: (i, j, 0)),
+            ],
+            out_shape=dkv_shapes,
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            compiler_params=_dim_semantics(3),
+            interpret=interpret,
+        )(kb, vb, qb_in, dob_in, lse4, delta4)
     return (_unbh(dq, b, h), _unbh(dk, b, h), _unbh(dv, b, h))
 
 
@@ -639,3 +1106,81 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# planning / accounting introspection (benchmarks + structural tests)
+# ---------------------------------------------------------------------------
+
+
+def flash_plan(t, d, *, dtype=jnp.float32, causal=False, window=None,
+               block_q=None, block_k=None):
+    """Static execution plan for `flash_attention` at this shape: block
+    sizes, per-kernel scheme, and per-kernel VISITED K/V (or Q/dO)
+    block counts — the exact fori/grid trip totals, derived from the
+    same `_k_span`/`_q_span`/`_window_span` the kernels use, so the
+    structural block-skip tests and published benchmark metadata
+    cannot drift from the implementation. `grid_blocks` is the
+    unskipped outer*inner product for comparison."""
+    isz = jnp.dtype(dtype).itemsize
+    tiles = _tiles(t, causal, block_q, block_k, window, d=d,
+                   itemsize=isz)
+    if tiles is None:
+        return {"scheme": "plain"}
+    bq, bk = tiles
+    nq, nk = t // bq, t // bk
+    plan = {"block_q": bq, "block_k": bk, "nq": nq, "nk": nk}
+    span = _window_span(window, bq, bk, nk) if causal else None
+    for which in ("fwd", "dq", "dkv"):
+        scheme = _choose_scheme(which, t, d, isz, bq, bk)
+        if which == "dkv":
+            grid_blocks = nk * nq
+            if scheme == "resident":
+                visited = 0
+                for jk in range(nk):
+                    lo, hi = _q_span(jk, nq, causal=causal,
+                                     window=window, block_q=bq,
+                                     block_k=bk)
+                    visited += int(hi) - int(lo)
+            else:
+                span_dkv = span if bq == bk else None
+                visited = nk * (span_dkv if span_dkv is not None
+                                else nq)
+        else:
+            grid_blocks = nq * nk
+            if scheme == "resident":
+                visited = 0
+                for iq in range(nq):
+                    lo, hi = _k_span(iq, nk, causal=causal,
+                                     window=window, block_q=bq,
+                                     block_k=bk)
+                    visited += int(hi) - int(lo)
+            else:
+                visited = nq * (span if span is not None else nk)
+        plan[which] = {"scheme": scheme, "visited_blocks": visited,
+                       "grid_blocks": grid_blocks}
+    return plan
+
+
+def flash_attention_flops(b, t, h, d, causal=False, window=None,
+                          backward=False):
+    """Useful matmul FLOPs of one flash_attention call (per the
+    standard 2-FLOPs/MAC convention), counting only VISIBLE (q, k)
+    position pairs — causal halves the full t^2, a sliding window caps
+    each row at window+1 — so achieved/peak from this numerator is the
+    honest kernel efficiency (masked-but-computed score area inside
+    partially visible blocks counts as overhead, not work). Forward:
+    QK^T + PV = 4*pairs*d; `backward=True` returns the fwd+bwd total
+    for a grad call (the four backward block matmuls add 8*pairs*d)."""
+    if causal:
+        if window is not None:
+            w = min(window, t - 1)
+            pairs = t * (w + 1) - w * (w + 1) // 2
+        else:
+            pairs = t * (t + 1) // 2
+    else:
+        pairs = t * t
+    flops = 4 * b * h * pairs * d
+    if backward:
+        flops += 8 * b * h * pairs * d
+    return flops

@@ -228,7 +228,11 @@ def _ring_flash(q, k, v, axis_name, causal, scale):
 
 def _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale):
     p = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    # rank gates which hops contribute, which only matters under the
+    # causal mask — computed lazily because axis_index lowers to a
+    # partition-id op some XLA versions refuse to SPMD-partition when
+    # it survives into the (otherwise rank-free) non-causal program
+    rank = lax.axis_index(axis_name) if causal else None
     perm = [(r, (r + 1) % p) for r in range(p)]
     f32 = jnp.float32
 
@@ -239,14 +243,16 @@ def _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale):
     def body(i, carry):
         out_acc, lse_acc, k_blk, v_blk = carry
         o_h, l_h = _hop_flash_fwd(q, k_blk, v_blk, False, scale)
-        src = (rank - i) % p
-        active = (src < rank) if causal else True
         lse_new = jnp.logaddexp(lse_acc, l_h)
         w_old = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
         w_new = jnp.exp(l_h - lse_new).transpose(0, 2, 1)[..., None]
         out_new = out_acc * w_old + o_h.astype(f32) * w_new
-        out_acc = jnp.where(active, out_new, out_acc)
-        lse_acc = jnp.where(active, lse_new, lse_acc)
+        if causal:
+            active = (rank - i) % p < rank
+            out_acc = jnp.where(active, out_new, out_acc)
+            lse_acc = jnp.where(active, lse_new, lse_acc)
+        else:
+            out_acc, lse_acc = out_new, lse_new
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return out_acc, lse_acc, k_blk, v_blk
@@ -267,7 +273,7 @@ def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale):
 def _ring_flash_vjp_bwd(axis_name, causal, scale, res, g):
     q, k, v, out, lse = res
     p = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    rank = lax.axis_index(axis_name) if causal else None  # see fwd loop
     perm = [(r, (r + 1) % p) for r in range(p)]
     f32 = jnp.float32
 
@@ -279,11 +285,15 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, res, g):
         dq_acc, dk_blk, dv_blk, k_blk, v_blk = carry
         dq_h, dk_h, dv_h = _hop_flash_bwd(q, k_blk, v_blk, out, lse, g,
                                           False, scale)
-        src = (rank - i) % p
-        active = (src < rank) if causal else True
-        dq_acc = jnp.where(active, dq_acc + dq_h, dq_acc)
-        dk_blk = jnp.where(active, dk_blk + dk_h, dk_blk)
-        dv_blk = jnp.where(active, dv_blk + dv_h, dv_blk)
+        if causal:
+            active = (rank - i) % p < rank
+            dq_acc = jnp.where(active, dq_acc + dq_h, dq_acc)
+            dk_blk = jnp.where(active, dk_blk + dk_h, dk_blk)
+            dv_blk = jnp.where(active, dv_blk + dv_h, dv_blk)
+        else:
+            dq_acc = dq_acc + dq_h
+            dk_blk = dk_blk + dk_h
+            dv_blk = dv_blk + dv_h
         # grads travel WITH their K/V block; after p total rotations
         # both are back at the block's home rank
         k_blk = lax.ppermute(k_blk, axis_name, perm)

@@ -22,4 +22,6 @@ os.environ.setdefault("KF_LOG_LEVEL", "warn")
 
 import jax  # noqa: E402  (must follow the env setup above)
 
+import kungfu_tpu._jax_compat  # noqa: E402, F401  (jax.shard_map on 0.4.x)
+
 jax.config.update("jax_platforms", "cpu")

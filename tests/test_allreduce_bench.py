@@ -4,14 +4,33 @@ Drives the real driver path (`benchmarks/allreduce.py` -> kfrun -> np
 worker processes -> libkf collectives) at np=2 on a small catalog model
 — the reference's kungfu-bench-allreduce exercised the same way its CI
 ran it (reference: tests/go/cmd/kungfu-bench-allreduce).
+
+Port ranges are chosen dynamically (anchored at an OS-assigned free
+port) instead of the old hardcoded 126xx/129xx ranges, so concurrent
+CI jobs on a shared host can't collide.
 """
+
+import socket
 
 from kungfu_tpu.benchmarks.allreduce import run_one
 
 
+def _free_port_range(span: int = 190) -> str:
+    """A `lo-hi` range anchored at a port the OS just handed out as
+    free. The rest of the range isn't guaranteed free, but the anchor
+    is fresh per call and per process, which removes the fixed-range
+    collisions between concurrent CI jobs that made these tests flaky
+    (kfrun probes forward through the range on a busy port anyway)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+    lo = min(max(base, 10000), 65535 - span)
+    return f"{lo}-{lo + span}"
+
+
 def test_np2_ring_smoke():
     row = run_one(2, "RING", "mlp-mnist", epochs=2, warmup=1,
-                  fuse=False, port_range="12600-12800")
+                  fuse=False, port_range=_free_port_range())
     assert row["np"] == 2
     assert row["strategy"] == "RING"
     assert row["tensors"] > 1          # per-tensor mode, real catalog
@@ -22,6 +41,6 @@ def test_np2_ring_smoke():
 
 def test_np2_fused_auto_smoke():
     row = run_one(2, "AUTO", "mlp-mnist", epochs=2, warmup=1,
-                  fuse=True, port_range="12810-12990")
+                  fuse=True, port_range=_free_port_range())
     assert row["tensors"] == 1         # fused: one packed buffer
     assert row["rate_gbps"] > 0
