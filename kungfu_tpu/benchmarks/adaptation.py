@@ -59,7 +59,14 @@ def worker(args) -> int:
             payload = elastic.resync_params(payload)
             ms = (time.perf_counter() - t0) * 1e3
             resize_ms.append(ms)
-            print(f"resize {old_size}->{p.size} {ms:.1f} ms", flush=True)
+            # phase decomposition (VERDICT r5 item 7): where inside the
+            # resize window the milliseconds actually go — the consensus
+            # wait (includes the joiner's boot on a grow), the native
+            # epoch adopt + join barrier, and the state broadcast
+            ph = elastic.last_resize_timings
+            detail = " ".join(f"{k}={v:.1f}" for k, v in ph.items())
+            print(f"resize {old_size}->{p.size} {ms:.1f} ms | {detail}",
+                  flush=True)
     if p.rank == 0 and resize_ms:
         print(
             f"adaptation np0={args.np} resizes={len(resize_ms)} "

@@ -1,0 +1,467 @@
+"""Deterministic fault-schedule engine for the elastic runtime.
+
+Every failure the test-suite injects — a worker SIGKILLed at step k, a
+config server refusing or delaying requests, a dropped control message,
+a corrupted checkpoint blob, a partitioned emulated host — is expressed
+as a first-class **schedule** instead of ad-hoc subprocess killing
+sprinkled through tests. A schedule is JSON, injected through the
+environment (the same channel the KF_* bootstrap protocol already
+uses), and is consulted at fixed hook points in the runtime:
+
+- ``on_step(rank, step)``        — ElasticCallback.after_step
+- ``on_http_request(path)``      — elastic/config_server handlers
+- ``on_control_send(name)``      — ffi.NativePeer.send_control
+- ``on_spawn(rank)``             — run/job.spawn_worker
+
+Hook points fire **deterministically**: faults match on exact
+(rank, step) / (path, request index) / (name, send index) coordinates
+and carry bounded trigger counts, so a chaos test replays the same
+failure at the same place every run. The only randomness is the byte
+positions of checkpoint corruption, drawn from the schedule's own seed.
+
+Schedule format (``KF_CHAOS`` inline JSON, or ``KF_CHAOS_FILE`` path)::
+
+    {"seed": 0, "faults": [
+        {"type": "crash_worker", "rank": 1, "step": 5, "signal": "KILL"},
+        {"type": "refuse_http", "path": "/put", "count": 3, "status": 503},
+        {"type": "delay_http", "path": "/get", "ms": 200, "count": 2},
+        {"type": "die_config_server", "after_requests": 10},
+        {"type": "drop_control", "name": "update", "count": 1},
+        {"type": "delay_control", "name": "update", "ms": 100, "count": 2},
+        {"type": "spawn_delay", "rank": 2, "ms": 500, "count": 1}
+    ]}
+
+Every fault that fires prints one ``KF_CHAOS_FIRE`` marker line with a
+wall-clock timestamp — the anchor the MTTR benchmark uses to measure
+detection latency from the instant of death.
+
+The reference project injects failures with docker-compose churn
+scripts (reference: benchmarks/adaptation/gen-compose.py); the netns
+fabric at the bottom of this module (`FakeNet`) is the
+container-runtime-free equivalent used by the churn/partition tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+ENV_INLINE = "KF_CHAOS"
+ENV_FILE = "KF_CHAOS_FILE"
+
+_KNOWN_TYPES = {
+    "crash_worker",
+    "refuse_http",
+    "delay_http",
+    "die_config_server",
+    "drop_control",
+    "delay_control",
+    "spawn_delay",
+}
+
+
+@dataclass
+class Fault:
+    type: str
+    spec: Dict = field(default_factory=dict)
+    remaining: int = 1
+
+    def matches(self, **coords) -> bool:
+        """True when every coordinate the SCHEDULE pins agrees with the
+        hook's coordinates; unpinned coordinates are wildcards."""
+        if self.remaining == 0:
+            return False
+        for key, have in coords.items():
+            want = self.spec.get(key)
+            if want is not None and want != have:
+                return False
+        return True
+
+    def consume(self) -> None:
+        if self.remaining > 0:
+            self.remaining -= 1
+
+
+class ChaosSchedule:
+    """A parsed fault schedule plus the per-process trigger state."""
+
+    def __init__(self, spec: Dict):
+        faults = spec.get("faults", [])
+        if not isinstance(faults, list):
+            raise ValueError("chaos schedule: 'faults' must be a list")
+        self.seed = int(spec.get("seed", 0))
+        self.faults: List[Fault] = []
+        for f in faults:
+            ftype = f.get("type")
+            if ftype not in _KNOWN_TYPES:
+                raise ValueError(f"chaos schedule: unknown fault type "
+                                 f"{ftype!r} (known: {sorted(_KNOWN_TYPES)})")
+            self.faults.append(Fault(
+                type=ftype,
+                spec=dict(f),
+                remaining=int(f.get("count", 1)),
+            ))
+        self._lock = threading.Lock()
+        self._http_requests = 0  # request index for die_config_server
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["ChaosSchedule"]:
+        e = os.environ if environ is None else environ
+        raw = e.get(ENV_INLINE, "")
+        if not raw and e.get(ENV_FILE):
+            with open(e[ENV_FILE]) as fh:
+                raw = fh.read()
+        if not raw:
+            return None
+        return cls(json.loads(raw))
+
+    def take(self, ftype: str, _when=None, **coords) -> Optional[Fault]:
+        """Atomically claim the first matching, non-exhausted fault.
+        ``_when`` (a predicate on the fault) gates the claim — used for
+        conditions beyond coordinate equality, e.g. request-count
+        thresholds."""
+        with self._lock:
+            for f in self.faults:
+                if f.type == ftype and f.matches(**coords):
+                    if _when is not None and not _when(f):
+                        continue
+                    f.consume()
+                    return f
+        return None
+
+    def next_http_index(self) -> int:
+        with self._lock:
+            self._http_requests += 1
+            return self._http_requests
+
+
+# -- per-process engine state -------------------------------------------------
+
+_sentinel = object()
+_active = _sentinel  # lazily parsed from env; _reset() re-arms
+
+
+def active() -> Optional[ChaosSchedule]:
+    """The process-wide schedule (parsed once from the environment)."""
+    global _active
+    if _active is _sentinel:
+        try:
+            _active = ChaosSchedule.from_env()
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            # a malformed schedule must not take the training job down —
+            # chaos is a test instrument, not a production dependency
+            print(f"[kf-chaos] ignoring bad schedule: {e}", flush=True)
+            _active = None
+    return _active
+
+
+def load(spec: Optional[Dict]) -> Optional[ChaosSchedule]:
+    """Install a schedule programmatically (tests); None disarms."""
+    global _active
+    _active = ChaosSchedule(spec) if spec is not None else None
+    return _active
+
+
+def _reset() -> None:
+    """Forget the cached schedule so the next hook re-reads the env."""
+    global _active
+    _active = _sentinel
+
+
+def _fire(ftype: str, **info) -> None:
+    kv = " ".join(f"{k}={v}" for k, v in info.items())
+    print(f"KF_CHAOS_FIRE t={time.time() * 1e3:.1f} type={ftype} {kv}",
+          flush=True)
+
+
+# -- hook points --------------------------------------------------------------
+
+def on_step(rank: int, step: int) -> None:
+    """ElasticCallback.after_step: scheduled worker crashes fire here."""
+    sched = active()
+    if sched is None:
+        return
+    f = sched.take("crash_worker", rank=rank, step=step)
+    if f is None:
+        return
+    sig = str(f.spec.get("signal", "KILL")).upper()
+    _fire("crash_worker", rank=rank, step=step, signal=sig)
+    if sig == "EXIT":
+        os._exit(int(f.spec.get("code", 41)))
+    os.kill(os.getpid(), getattr(signal, f"SIG{sig}", signal.SIGKILL))
+
+
+def on_http_request(path: str) -> Optional[Dict]:
+    """Config-server handler hook. Returns the action to apply:
+    ``{"refuse": status}``, ``{"delay_ms": ms}``, ``{"die": True}`` or
+    None. Delay faults sleep HERE (inside the handler thread) so the
+    caller sees real latency, not a fast error."""
+    sched = active()
+    if sched is None:
+        return None
+    idx = sched.next_http_index()
+    f = sched.take(
+        "die_config_server",
+        _when=lambda f: idx >= int(f.spec.get("after_requests", 0)))
+    if f is not None:
+        _fire("die_config_server", request=idx)
+        return {"die": True}
+    f = sched.take("delay_http", path=path)
+    if f is not None:
+        ms = float(f.spec.get("ms", 100))
+        _fire("delay_http", path=path, ms=ms, request=idx)
+        time.sleep(ms / 1e3)
+        return {"delay_ms": ms}
+    f = sched.take("refuse_http", path=path)
+    if f is not None:
+        status = int(f.spec.get("status", 503))
+        _fire("refuse_http", path=path, status=status, request=idx)
+        return {"refuse": status}
+    return None
+
+
+def on_control_send(name: str) -> str:
+    """ffi.send_control hook: 'drop' to swallow the message, 'send' to
+    proceed (after any scheduled delay)."""
+    sched = active()
+    if sched is None:
+        return "send"
+    f = sched.take("drop_control", name=name)
+    if f is not None:
+        _fire("drop_control", name=name)
+        return "drop"
+    f = sched.take("delay_control", name=name)
+    if f is not None:
+        ms = float(f.spec.get("ms", 100))
+        _fire("delay_control", name=name, ms=ms)
+        time.sleep(ms / 1e3)
+    return "send"
+
+
+def on_spawn(rank: Optional[int]) -> None:
+    """run/job.spawn_worker hook: scheduled joiner-spawn delay (models a
+    slow host answering a grow proposal)."""
+    sched = active()
+    if sched is None:
+        return
+    f = sched.take("spawn_delay", rank=rank)
+    if f is not None:
+        ms = float(f.spec.get("ms", 100))
+        _fire("spawn_delay", rank=rank, ms=ms)
+        time.sleep(ms / 1e3)
+
+
+def corrupt_file(path: str, nbytes: int = 8,
+                 seed: Optional[int] = None) -> List[int]:
+    """Flip ``nbytes`` bytes of a blob at schedule-seeded offsets — the
+    "corrupt a checkpoint" fault. Returns the corrupted offsets so a
+    test can assert determinism. The checkpoint loader is expected to
+    FAIL LOUDLY on such a file (np.load CRC) — recovery then falls back
+    to the live resync path instead of restoring garbage."""
+    if seed is None:
+        sched = active()
+        seed = sched.seed if sched is not None else 0
+    size = os.path.getsize(path)
+    if size == 0:
+        return []
+    rng = random.Random(seed)
+    # DISTINCT offsets: sampling with replacement could XOR one byte an
+    # even number of times and hand back a byte-identical "corrupt" file
+    offsets = sorted(rng.sample(range(size), min(nbytes, size)))
+    with open(path, "r+b") as f:
+        for off in offsets:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+    _fire("corrupt_checkpoint", path=path, nbytes=nbytes, seed=seed)
+    return offsets
+
+
+# -- netns fault fabric -------------------------------------------------------
+
+_NETNS_CAPABLE: Optional[bool] = None
+
+
+def netns_capable() -> bool:
+    """True when this environment can create network namespaces with
+    veth pairs AND the veth link state is actually honored (root +
+    CAP_NET_ADMIN; denied in most unprivileged CI sandboxes, granted in
+    the dev container).
+
+    The link-state check matters: some sandboxed kernels (gVisor-style)
+    report `ip netns add` / `ip link set ... down` success, yet keep
+    delivering packets across the administratively-down link — a veth
+    partition is then a silent no-op and every fault these namespaces
+    back would pass vacuously. The probe downs one end of a fresh veth
+    pair and tries to connect across it: a real stack has no route any
+    more (ENETUNREACH/EHOSTUNREACH, or a timeout where only the route
+    survives); a stack that ignores link state delivers the SYN and
+    fails ECONNREFUSED — or even connects. The (~2 s) verdict is cached
+    per process."""
+    global _NETNS_CAPABLE
+    if _NETNS_CAPABLE is None:
+        _NETNS_CAPABLE = _probe_netns()
+    return _NETNS_CAPABLE
+
+
+def _probe_netns() -> bool:
+    import sys
+    tag = f"{os.getpid() % 10000}"
+    ns_a, ns_b = f"kfcapchk{tag}a", f"kfcapchk{tag}b"
+    veth_a, veth_b = f"kfcpk{tag}a", f"kfcpk{tag}b"
+    try:
+        r = subprocess.run(["unshare", "-n", "true"], timeout=10,
+                           capture_output=True)
+        if r.returncode != 0:
+            return False
+        for ns in (ns_a, ns_b):
+            if subprocess.run(["ip", "netns", "add", ns], timeout=10,
+                              capture_output=True).returncode != 0:
+                return False
+        r = subprocess.run(["ip", "link", "add", veth_a, "type", "veth",
+                            "peer", "name", veth_b], timeout=10,
+                           capture_output=True)
+        if r.returncode != 0:
+            return False
+        _ip("link", "set", veth_a, "netns", ns_a)
+        _ip("link", "set", veth_b, "netns", ns_b)
+        _ip("-n", ns_a, "addr", "add", "10.254.77.1/24", "dev", veth_a)
+        _ip("-n", ns_b, "addr", "add", "10.254.77.2/24", "dev", veth_b)
+        _ip("-n", ns_a, "link", "set", veth_a, "up")
+        _ip("-n", ns_b, "link", "set", veth_b, "up")
+        _ip("-n", ns_a, "link", "set", veth_a, "down")
+        r = subprocess.run(
+            ["ip", "netns", "exec", ns_a, sys.executable, "-c",
+             "import errno, socket, sys\n"
+             "try:\n"
+             "    socket.create_connection(('10.254.77.2', 9), timeout=3)\n"
+             "    sys.exit(1)  # connected across a DOWNED link\n"
+             "except socket.timeout:\n"
+             "    sys.exit(0)  # silence: link state honored\n"
+             "except OSError as e:\n"
+             "    ok = e.errno in (errno.ENETUNREACH, errno.EHOSTUNREACH)\n"
+             "    sys.exit(0 if ok else 1)\n"],
+            timeout=20, capture_output=True)
+        return r.returncode == 0
+    except Exception:
+        return False
+    finally:
+        # the veth pair only dies with the netns AFTER the move into it;
+        # a failure between 'link add' and the move would leave it in the
+        # root namespace and poison every later probe with 'File exists'
+        subprocess.run(["ip", "link", "del", veth_a], timeout=10,
+                       capture_output=True)
+        for ns in (ns_a, ns_b):
+            subprocess.run(["ip", "netns", "del", ns], timeout=10,
+                           capture_output=True)
+
+
+def _ip(*args: str, check: bool = True) -> subprocess.CompletedProcess:
+    r = subprocess.run(["ip", *args], capture_output=True, text=True,
+                       timeout=15)
+    if check and r.returncode != 0:
+        raise RuntimeError(f"ip {' '.join(args)}: {r.stderr}")
+    return r
+
+
+@dataclass
+class FakeHost:
+    name: str
+    ns: str
+    ip: str
+    veth_host: str  # bridge side
+    veth_ns: str    # namespace side
+
+
+class FakeNet:
+    """N netns-backed fake hosts joined by one bridge — the
+    container-free stand-in for the reference's docker-compose cluster
+    (reference: benchmarks/adaptation/gen-compose.py). Hosts can be
+    added and removed while the cluster runs (churn), and any host can
+    be partitioned (link down, process tree stays alive) and healed.
+
+    Each host gets an /etc/hosts-style name through
+    ``publish_etc_hosts`` so hostname discovery (`run/discovery.py`)
+    resolves fake hosts the way orchestrator DNS would."""
+
+    def __init__(self, tag: str, subnet: str = "10.77.40"):
+        self.tag = tag
+        self.subnet = subnet
+        self.bridge = f"br{tag}"[:15]
+        self.hosts: Dict[str, FakeHost] = {}
+        self._next = 1
+        _ip("link", "add", self.bridge, "type", "bridge")
+        _ip("link", "set", self.bridge, "up")
+        _ip("addr", "add", f"{subnet}.254/24", "dev", self.bridge)
+
+    def add_host(self, name: str) -> FakeHost:
+        i = self._next
+        self._next += 1
+        ns = f"{self.tag}{name}"[:15]
+        veth_h = f"vh{self.tag}{i}"[:15]
+        veth_n = f"vn{self.tag}{i}"[:15]
+        ip_addr = f"{self.subnet}.{i}"
+        _ip("netns", "add", ns)
+        _ip("-n", ns, "link", "set", "lo", "up")
+        _ip("link", "add", veth_h, "type", "veth", "peer", "name", veth_n)
+        _ip("link", "set", veth_h, "master", self.bridge)
+        _ip("link", "set", veth_h, "up")
+        _ip("link", "set", veth_n, "netns", ns)
+        _ip("-n", ns, "addr", "add", f"{ip_addr}/24", "dev", veth_n)
+        _ip("-n", ns, "link", "set", veth_n, "up")
+        host = FakeHost(name=name, ns=ns, ip=ip_addr,
+                        veth_host=veth_h, veth_ns=veth_n)
+        self.hosts[name] = host
+        return host
+
+    def remove_host(self, name: str) -> None:
+        host = self.hosts.pop(name)
+        subprocess.run(["ip", "netns", "del", host.ns],
+                       capture_output=True, timeout=15)
+
+    def partition(self, name: str) -> None:
+        """Drop the host's uplink: alive but unreachable (a PARTITION,
+        distinct from a crash — the process tree keeps running)."""
+        _fire("partition_host", host=name)
+        _ip("link", "set", self.hosts[name].veth_host, "down")
+
+    def heal(self, name: str) -> None:
+        _fire("heal_host", host=name)
+        _ip("link", "set", self.hosts[name].veth_host, "up")
+
+    def exec_prefix(self, name: str) -> List[str]:
+        """argv prefix running a command inside the fake host."""
+        return ["ip", "netns", "exec", self.hosts[name].ns]
+
+    def publish_etc_hosts(self) -> None:
+        """Write every live host's name→IP into /etc/netns/<ns>/hosts:
+        `ip netns exec` bind-mounts those files over /etc inside the
+        namespace, so HOSTNAME discovery (`run/discovery.py`) resolves
+        fake hosts exactly the way orchestrator DNS would. Call again
+        after add_host/remove_host to refresh every view."""
+        lines = "".join(f"{h.ip} {h.name}\n"
+                        for h in sorted(self.hosts.values(),
+                                        key=lambda h: h.name))
+        for h in self.hosts.values():
+            d = f"/etc/netns/{h.ns}"
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "hosts"), "w") as fh:
+                fh.write("127.0.0.1 localhost\n" + lines)
+
+    def cleanup(self) -> None:
+        import shutil
+
+        for name in list(self.hosts):
+            ns = self.hosts[name].ns
+            self.remove_host(name)
+            shutil.rmtree(f"/etc/netns/{ns}", ignore_errors=True)
+        subprocess.run(["ip", "link", "del", self.bridge],
+                       capture_output=True, timeout=15)

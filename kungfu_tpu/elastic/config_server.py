@@ -18,18 +18,27 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from .. import chaos
 from ..peer import Stage
 from ..plan import Cluster
 
 
 class ConfigServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 9100):
+    def __init__(self, host: str = "127.0.0.1", port: int = 9100,
+                 standalone: bool = False):
         self.host = host
         self.port = port
+        #: standalone (own process, `python -m ...config_server`): a
+        #: chaos die_config_server fault _exits_ the process like a real
+        #: crash; in-process (test thread): it tears the listener down
+        #: abruptly instead, so the host test survives
+        self.standalone = standalone
         self._lock = threading.Lock()
         self._stage: Optional[Stage] = None
         self._initial: Optional[Stage] = None
@@ -101,7 +110,30 @@ class ConfigServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _chaos(self) -> bool:
+                """Consult the fault schedule; True when the request was
+                consumed by a fault (refused or the server died)."""
+                action = chaos.on_http_request(self.path)
+                if not action:
+                    return False
+                if action.get("die"):
+                    server._chaos_die()
+                    # drop the connection WITHOUT a reply: the client
+                    # sees a reset, exactly like a real crash mid-request
+                    try:
+                        self.connection.close()
+                    except OSError:
+                        pass
+                    return True
+                if "refuse" in action:
+                    self._reply(int(action["refuse"]),
+                                '{"error": "chaos refusal"}')
+                    return True
+                return False  # delay faults sleep inside the hook
+
             def do_GET(self):
+                if self._chaos():
+                    return
                 if self.path.startswith("/get"):
                     body = server.stage_json()
                     if body is None:
@@ -116,6 +148,8 @@ class ConfigServer:
                     self._reply(404, '{"error": "unknown path"}')
 
             def _do_update(self):
+                if self._chaos():
+                    return
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n).decode() if n else ""
                 err = None
@@ -154,10 +188,36 @@ class ConfigServer:
         return self
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        # atomic swap: a scheduled _chaos_die stop thread can race a
+        # caller's stop()/restart() — only one of them may shutdown/close
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+
+    def _chaos_die(self):
+        """A scheduled config-server crash fired."""
+        if self.standalone:
+            os._exit(17)  # abrupt: no atexit, no socket lingering
+        threading.Thread(target=self.stop, daemon=True).start()
+
+    def restart(self) -> "ConfigServer":
+        """Bring a (chaos-)killed in-process server back on the SAME
+        port with its state — the 'config server restarts mid-training'
+        scenario; clients meanwhile ride the shared retry policy."""
+        self.stop()
+        # a concurrent _chaos_die stop thread that won the swap may still
+        # hold the listening socket for a moment — retry the rebind
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                return self.start()
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
 
     @property
     def get_url(self) -> str:
@@ -169,7 +229,7 @@ def main(argv=None):
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=9100)
     args = ap.parse_args(argv)
-    server = ConfigServer(args.host, args.port).start()
+    server = ConfigServer(args.host, args.port, standalone=True).start()
     print(f"[kf-config-server] serving on {server.get_url}", flush=True)
     try:
         server._thread.join()

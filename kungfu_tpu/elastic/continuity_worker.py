@@ -10,15 +10,25 @@ checks make the state broadcast load-bearing:
 - a SURVIVOR asserts the first post-resize loss stays near its
   pre-resize loss (no reset to init-level loss).
 
+With KF_RECOVER=1 the same trainer also exercises the survivor-driven
+FAILURE path: when a peer dies mid-step (e.g. a chaos-scheduled
+crash_worker fault), the collective fails fast with KF_ERR_CONN, the
+worker calls `ElasticCallback.recover` — adopting the shrunken stage
+the detecting runner proposed, re-broadcasting params+optimizer state
+from the new rank 0 — and continues training with the SAME survivor
+loss-continuity assertion as a planned resize. No operator action.
+
 Markers: CONTINUITY_MARKERS in `elastic.harness` — parsed by
 tests/test_elastic.py and the driver's
 `__graft_entry__.dryrun_multichip` elastic phase, both via
-`kungfu_tpu.elastic.harness.run_loss_continuity`.
+`kungfu_tpu.elastic.harness.run_loss_continuity`; recovery runs add
+KF_RECOVERY_CAUGHT / KF_RECOVERY_DONE (see harness.RECOVERY_MARKERS).
 
 Run under kfrun as `python -m kungfu_tpu.elastic.continuity_worker`.
 """
 
 import os
+import time
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -33,12 +43,16 @@ import kungfu_tpu
 from kungfu_tpu.data import ElasticSampler
 from kungfu_tpu.datasets import load_synthetic_split
 from kungfu_tpu.elastic import ElasticCallback
+from kungfu_tpu.ffi import KfError
 from kungfu_tpu.initializer import broadcast_variables
 from kungfu_tpu.models import SLP
 from kungfu_tpu.ops.collective import defuse, fuse
 
 TOTAL_STEPS = int(os.environ.get("TEST_TOTAL_STEPS", "12"))
 SCHEDULE = os.environ.get("TEST_SCHEDULE", "6:2,6:4")
+RECOVER = os.environ.get("KF_RECOVER", "0") == "1"
+RECOVERY_DEADLINE_S = float(
+    os.environ.get("KF_RECOVERY_DEADLINE_MS", "30000")) / 1e3
 BATCH = 64
 LR = 0.1
 
@@ -89,15 +103,58 @@ if peer.config.version > 0:
 else:
     sampler = make_sampler()
 
+just_recovered = False
+
+
+def try_recover():
+    """Survivor path: adopt the runner-proposed shrunken stage and
+    restore params+optimizer state from the new rank 0, mutating the
+    module-level params/opt_state/sampler in place. On failure it exits:
+    SystemExit(0) when the recovery stage evicted this worker (same
+    clean exit as a planned-resize eviction), SystemExit(43) when no
+    recovery stage arrived in time (fail fast)."""
+    global params, opt_state, sampler, pending_continuity, just_recovered
+    print(f"KF_RECOVERY_CAUGHT rank={peer.rank} "
+          f"step={elastic.state.step}", flush=True)
+    out = elastic.recover(params=(params, opt_state),
+                          deadline_s=RECOVERY_DEADLINE_S)
+    if out is None:
+        if not elastic.state.keep:
+            # the recovery stage evicted US — a legitimate outcome,
+            # same clean exit as a planned-resize eviction
+            print(f"evicted during recovery at step "
+                  f"{elastic.state.step}", flush=True)
+            raise SystemExit(0)
+        raise SystemExit(43)  # no recovery stage in time: fail fast
+    params, opt_state = out
+    sampler = make_sampler()
+    pending_continuity = last_loss
+    just_recovered = True
+    print(f"KF_RECOVERY_DONE rank={peer.rank} size={peer.size} "
+          f"epoch={peer.version} step={elastic.state.step}", flush=True)
+
+
 last_loss = None
-pending_continuity = None  # survivor's pre-resize loss
+pending_continuity = None  # survivor's pre-resize/pre-recovery loss
 while elastic.state.step < TOTAL_STEPS:
     idx = sampler.next_indices()
     batch = {"x": x[idx], "y": y[idx]}
     loss, grads = loss_and_grads(params, batch)
     loss = float(loss)
-    buf = peer.all_reduce(np.asarray(fuse(grads)),
-                          name=f"g:{peer.version}:{elastic.state.step}")
+    try:
+        buf = peer.all_reduce(np.asarray(fuse(grads)),
+                              name=f"g:{peer.version}:{elastic.state.step}")
+    except KfError:
+        if not RECOVER:
+            raise
+        try_recover()
+        continue  # redo this step in the shrunken epoch
+    if just_recovered:
+        # first data-plane collective of the recovered epoch succeeded:
+        # this closes the MTTR window the recovery benchmark measures
+        print(f"KF_MTTR resumed t={time.time() * 1e3:.1f} "
+              f"rank={peer.rank} step={elastic.state.step}", flush=True)
+        just_recovered = False
     grads = defuse(jnp.asarray(buf) / peer.size, grads)
     updates, opt_state = tx.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
@@ -112,7 +169,16 @@ while elastic.state.step < TOTAL_STEPS:
         pending_continuity = None
     last_loss = loss
 
-    if elastic.after_step():
+    try:
+        changed = elastic.after_step()
+    except KfError:
+        # a peer died inside the resize consensus round (or the chaos
+        # victim was *us* and this line never returns)
+        if not RECOVER:
+            raise
+        try_recover()
+        continue
+    if changed:
         if not elastic.state.keep:
             print(f"evicted at step {elastic.state.step}", flush=True)
             raise SystemExit(0)

@@ -27,6 +27,19 @@ CONTINUITY_MARKERS = (
     ("KF_CONTINUITY_DONE", "schedule did not complete"),
 )
 
+RECOVERY_MARKERS = (
+    ("KF_CHAOS_FIRE", "the scheduled fault never fired"),
+    ("KF_MTTR detect", "the runner never detected the death"),
+    ("KF_MTTR proposed", "no shrunken stage was proposed"),
+    ("KF_RECOVERY_CAUGHT", "no survivor caught the collective failure"),
+    ("KF_MTTR adopted", "survivors never adopted the recovery stage"),
+    ("KF_MTTR restored", "survivor state restore did not run"),
+    ("KF_RECOVERY_DONE", "no survivor resumed training"),
+    ("KF_MTTR resumed", "no post-recovery collective completed"),
+    ("KF_SURVIVOR_CONTINUITY", "post-recovery loss continuity unproven"),
+    ("KF_CONTINUITY_DONE", "training did not finish after recovery"),
+)
+
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -45,6 +58,79 @@ def ensure_libkf() -> None:
             f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
 
 
+def _run_continuity_cluster(schedule: str,
+                            total_steps: int,
+                            start_np: int,
+                            slots: int,
+                            port_range: str,
+                            timeout: int,
+                            logdir: str | None,
+                            markers,
+                            extra_env: dict | None = None,
+                            extra_flags: list | None = None,
+                            expect_rc: int = 0,
+                            server=None) -> str:
+    """Boot config server + kfrun -w + continuity_worker; assert the
+    given marker set against the combined runner+worker logs. Pass a
+    running `server` (e.g. one with an in-process chaos schedule) to
+    keep its lifecycle with the caller."""
+    ensure_libkf()
+    from .config_server import ConfigServer
+
+    own_server = server is None
+    if own_server:
+        server = ConfigServer(port=0).start()
+    own_logdir = logdir is None
+    tmp = tempfile.TemporaryDirectory() if own_logdir else None
+    logdir = tmp.name if own_logdir else logdir
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["KF_TIMEOUT_MS"] = env.get("KF_TIMEOUT_MS", "120000")
+        env["KF_LOG_LEVEL"] = "warn"
+        env["PALLAS_AXON_POOL_IPS"] = ""  # control-plane-only workers
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TEST_SCHEDULE"] = schedule
+        env["TEST_TOTAL_STEPS"] = str(total_steps)
+        if extra_env:
+            env.update(extra_env)
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.run",
+             "-np", str(start_np), "-H", f"127.0.0.1:{slots}",
+             "-port-range", port_range,
+             "-w", "-config-server", server.get_url,
+             "-logdir", logdir, "-q"]
+            + (extra_flags or [])
+            + ["--", sys.executable, "-m",
+               "kungfu_tpu.elastic.continuity_worker"],
+            cwd=_REPO, env=env, timeout=timeout, capture_output=True,
+            text=True)
+        logs = ""
+        for f in sorted(os.listdir(logdir)):
+            if f.endswith(".log"):
+                with open(os.path.join(logdir, f)) as fh:
+                    logs += f"--- {f} ---\n" + fh.read()
+        # runner stdout carries the KF_MTTR detect/proposed markers
+        logs += f"--- runner ---\n{r.stdout}"
+        if r.returncode != expect_rc:
+            raise AssertionError(
+                f"elastic continuity run failed rc={r.returncode} "
+                f"(expected {expect_rc}):\n"
+                f"stdout: {r.stdout[-2000:]}\n"
+                f"stderr: {r.stderr[-2000:]}\n{logs[-2000:]}")
+        for marker, why in markers:
+            if marker not in logs:
+                raise AssertionError(
+                    f"elastic continuity: {why} ({marker} missing):\n"
+                    f"{logs[-3000:]}")
+        return logs
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+        if own_server:
+            server.stop()
+
+
 def run_loss_continuity(schedule: str = "6:2,6:4",
                         total_steps: int = 12,
                         start_np: int = 2,
@@ -58,49 +144,57 @@ def run_loss_continuity(schedule: str = "6:2,6:4",
     itself asserts the actual loss relations and exits nonzero on
     violation, so a green return means the state broadcast carried
     trained weights through the resize."""
-    ensure_libkf()
-    from .config_server import ConfigServer
+    return _run_continuity_cluster(
+        schedule, total_steps, start_np, slots, port_range, timeout,
+        logdir, CONTINUITY_MARKERS)
 
-    server = ConfigServer(port=0).start()
-    own_logdir = logdir is None
-    tmp = tempfile.TemporaryDirectory() if own_logdir else None
-    logdir = tmp.name if own_logdir else logdir
-    try:
-        env = dict(os.environ)
-        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["KF_TIMEOUT_MS"] = env.get("KF_TIMEOUT_MS", "120000")
-        env["KF_LOG_LEVEL"] = "warn"
-        env["PALLAS_AXON_POOL_IPS"] = ""  # control-plane-only workers
-        env["JAX_PLATFORMS"] = "cpu"
-        env["TEST_SCHEDULE"] = schedule
-        env["TEST_TOTAL_STEPS"] = str(total_steps)
-        r = subprocess.run(
-            [sys.executable, "-m", "kungfu_tpu.run",
-             "-np", str(start_np), "-H", f"127.0.0.1:{slots}",
-             "-port-range", port_range,
-             "-w", "-config-server", server.get_url,
-             "-logdir", logdir, "-q",
-             "--", sys.executable, "-m",
-             "kungfu_tpu.elastic.continuity_worker"],
-            cwd=_REPO, env=env, timeout=timeout, capture_output=True,
-            text=True)
-        logs = ""
-        for f in sorted(os.listdir(logdir)):
-            if f.endswith(".log"):
-                with open(os.path.join(logdir, f)) as fh:
-                    logs += f"--- {f} ---\n" + fh.read()
-        if r.returncode != 0:
-            raise AssertionError(
-                f"elastic continuity run failed rc={r.returncode}:\n"
-                f"stdout: {r.stdout[-2000:]}\n"
-                f"stderr: {r.stderr[-2000:]}\n{logs[-2000:]}")
-        for marker, why in CONTINUITY_MARKERS:
-            if marker not in logs:
-                raise AssertionError(
-                    f"elastic continuity: {why} ({marker} missing):\n"
-                    f"{logs[-2000:]}")
-        return logs
-    finally:
-        if tmp is not None:
-            tmp.cleanup()
-        server.stop()
+
+def run_survivor_recovery(crash_rank: int = 1,
+                          crash_step: int = 5,
+                          total_steps: int = 12,
+                          start_np: int = 3,
+                          slots: int = 4,
+                          port_range: str = "27100-27999",
+                          timeout: int = 600,
+                          logdir: str | None = None) -> str:
+    """Kill one worker mid-training via a chaos schedule and assert the
+    survivors shrink membership, restore state, and finish the run with
+    loss continuity — no operator action. The full recovery pipeline is
+    asserted marker by marker (RECOVERY_MARKERS): fault fired → runner
+    detected → shrunken stage proposed → survivors adopted → state
+    restored → training resumed → loss continuous → run completed.
+
+    The schedule pins the cluster at `start_np` for the whole run, so
+    no resize is PLANNED — but after the recovery shrink the schedule
+    observes size < target and re-grows through the ordinary elastic
+    path, spawning a replacement joiner. That self-heal is part of the
+    asserted scenario (the reference's respawn-from-survivors model);
+    it happens strictly AFTER the `KF_MTTR resumed` marker, so the MTTR
+    window measured by benchmarks/recovery.py never includes the
+    joiner's boot."""
+    import json as _json
+
+    chaos_spec = _json.dumps({"faults": [{
+        "type": "crash_worker", "rank": crash_rank, "step": crash_step,
+        "signal": "KILL",
+    }]})
+    return _run_continuity_cluster(
+        # flat schedule: the only UNPLANNED switch is the recovery; the
+        # re-grow back to start_np afterwards is schedule-driven
+        schedule=f"{total_steps + 1}:{start_np}",
+        total_steps=total_steps,
+        start_np=start_np,
+        slots=slots,
+        port_range=port_range,
+        timeout=timeout,
+        logdir=logdir,
+        markers=RECOVERY_MARKERS,
+        extra_env={
+            "KF_CHAOS": chaos_spec,
+            "KF_RECOVER": "1",
+            # fast failure detection: survivors' blocked receives fail
+            # on conn EOF (no timeout wait), but keep a short ceiling
+            "KF_RECOVERY_DEADLINE_MS": "30000",
+        },
+        extra_flags=["-recover"],
+    )

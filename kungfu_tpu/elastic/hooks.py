@@ -14,11 +14,13 @@ change (SURVEY §7 "elastic resize x static XLA meshes").
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
 import numpy as np
 
+from .. import chaos
 from ..ops.collective import pack_bytes, unpack_bytes
 from ..peer import Peer
 from .schedule import step_based_schedule
@@ -62,6 +64,11 @@ class ElasticCallback:
         self.config_server = config_server or peer.config.config_server
         self.samples_per_step = samples_per_step
         self.state = ElasticState()
+        # consecutive propose failures — bounded visibility, not silence
+        self._propose_failures = 0
+        #: per-phase wall times (ms) of the last completed epoch switch,
+        #: merged from peer.last_resize_phases + the resync phases below
+        self.last_resize_timings: dict = {}
 
     def after_step(self) -> bool:
         """Advance one step; returns True when cluster membership changed
@@ -69,6 +76,10 @@ class ElasticCallback:
         st = self.state
         st.step += 1
         st.trained_samples += self.samples_per_step * self.peer.size
+        # deterministic fault injection: a scheduled crash_worker fault
+        # for (rank, step) fires here, so chaos tests drive the SAME
+        # step boundary production failures hit (kungfu_tpu/chaos.py)
+        chaos.on_step(self.peer.rank, st.step)
         want = None
         if self.schedule:
             want = step_based_schedule(self.schedule, st.step)
@@ -78,12 +89,67 @@ class ElasticCallback:
             want = self.policy(self.peer.size)
         if want is not None and self.peer.rank == 0:
             try:
+                # propose_new_size's fetch/put ride the shared retry
+                # policy (kungfu_tpu/retrying.py) — transient server
+                # hiccups are backed off and LOGGED there; what reaches
+                # this handler already exhausted its bounded attempts
                 self.peer.propose_new_size(want, self.config_server)
-            except Exception as e:  # config server hiccup: retry later
-                print(f"[kf-elastic] propose failed: {e}", flush=True)
+                self._propose_failures = 0
+            except Exception as e:
+                self._propose_failures += 1
+                print(
+                    f"[kf-elastic] propose(size={want}) gave up after "
+                    f"bounded retries ({self._propose_failures} "
+                    f"consecutive): {e}",
+                    flush=True,
+                )
         changed, keep = self.peer.resize_from_url(self.config_server)
         st.changed, st.keep = changed, keep
         return changed
+
+    # -- survivor-driven failure recovery ------------------------------------
+
+    def recover(self, params=None, deadline_s: float = 30.0):
+        """Rejoin training after a collective failed with a peer death.
+
+        Polls the config server until the detecting runner's shrunken
+        stage appears, adopts it (`Peer.recover_from_url` — no vote from
+        the dead peer needed), then restores state across the survivors:
+        re-broadcast `params` from the new rank 0 and re-agree the
+        training position. Emits `KF_MTTR` markers for each phase so the
+        recovery benchmark can decompose detect/consensus/restore.
+
+        Returns the (possibly re-broadcast) params on success, None when
+        no recovery stage arrived within `deadline_s` or this worker was
+        evicted — the caller should then fall back to fail-fast (raise /
+        exit nonzero)."""
+        t0 = time.time()
+        print(f"KF_MTTR error t={t0 * 1e3:.1f} rank={self.peer.rank} "
+              f"epoch={self.peer.version}", flush=True)
+        recovered, keep = self.peer.recover_from_url(
+            self.config_server, deadline_s=deadline_s)
+        if not recovered or not keep:
+            # state.keep lets the caller tell a legitimate eviction
+            # (exit 0, like the planned-resize path) from a recovery
+            # timeout (fail fast)
+            self.state.changed, self.state.keep = recovered, keep
+            print(f"KF_MTTR giveup t={time.time() * 1e3:.1f} "
+                  f"recovered={recovered} keep={keep}", flush=True)
+            return None
+        t1 = time.time()
+        print(f"KF_MTTR adopted t={t1 * 1e3:.1f} rank={self.peer.rank} "
+              f"epoch={self.peer.version} size={self.peer.size}",
+              flush=True)
+        if params is not None:
+            params = self.resync_params(params)
+        else:
+            self.sync_position()
+        t2 = time.time()
+        print(f"KF_MTTR restored t={t2 * 1e3:.1f} rank={self.peer.rank} "
+              f"adopt_ms={(t1 - t0) * 1e3:.1f} "
+              f"restore_ms={(t2 - t1) * 1e3:.1f}", flush=True)
+        self.state.changed, self.state.keep = True, True
+        return params if params is not None else True
 
     # -- state resync over the control plane --------------------------------
 
@@ -100,11 +166,26 @@ class ElasticCallback:
     def resync_params(self, params, root: int = 0):
         """Broadcast a params pytree from `root` over DCN so joiners adopt
         survivor state (the reference's BroadcastGlobalVariablesOp at the
-        epoch boundary). Byte-exact: dtypes (incl. ints/bools) survive."""
+        epoch boundary). Byte-exact: dtypes (incl. ints/bools) survive.
+
+        Records broadcast/position phase times into
+        `last_resize_timings` (merged with the peer's fetch/consensus/
+        adopt-barrier phases) — the decomposition VERDICT r5 item 7
+        asked for on the 1420 ms grow."""
+        t0 = time.perf_counter()
         packed = pack_bytes(params)
+        t_pack = time.perf_counter()
         synced = self.peer.broadcast(packed, root=root,
                                      name="kf::elastic::model")
+        t_bcast = time.perf_counter()
         self.sync_position()
+        t_pos = time.perf_counter()
+        self.last_resize_timings = {
+            **self.peer.last_resize_phases,
+            "pack_ms": (t_pack - t0) * 1e3,
+            "broadcast_ms": (t_bcast - t_pack) * 1e3,
+            "position_ms": (t_pos - t_bcast) * 1e3,
+        }
         return unpack_bytes(synced, params)
 
 

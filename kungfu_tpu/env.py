@@ -28,6 +28,17 @@ CONFIG_VARS = (
     "KF_STALL_DETECTION",
     "KF_TIMEOUT_MS",
     "KF_ENABLE_MONITORING",
+    # failure recovery + retry policy knobs (docs/fault_tolerance.md)
+    "KF_RECOVER",
+    "KF_RECOVERY_BUDGET",
+    "KF_RECOVERY_DEADLINE_MS",
+    "KF_RETRY_ATTEMPTS",
+    "KF_RETRY_BASE_MS",
+    "KF_RETRY_MAX_MS",
+    "KF_RETRY_DEADLINE_MS",
+    # deterministic fault schedules (kungfu_tpu/chaos.py)
+    "KF_CHAOS",
+    "KF_CHAOS_FILE",
 )
 
 ALL_BOOTSTRAP_VARS = (

@@ -539,6 +539,12 @@ class NativePeer:
                                                 None), "set control handler")
 
     def send_control(self, dest: str, name: str, payload: bytes = b""):
+        # chaos hook: a scheduled drop_control/delay_control fault
+        # swallows or delays this control message deterministically
+        # (local import: chaos is pure stdlib but ffi loads first)
+        from . import chaos
+        if chaos.on_control_send(name) == "drop":
+            return
         buf = np.frombuffer(payload, dtype=np.uint8) if payload else None
         ptr = _buf_ptr(buf) if buf is not None else None
         _check(

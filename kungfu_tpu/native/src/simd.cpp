@@ -18,6 +18,7 @@
 
 #if defined(__x86_64__) || defined(__i386__)
 #define KF_X86 1
+#include <cpuid.h>
 #include <immintrin.h>
 #endif
 
@@ -27,12 +28,23 @@ namespace kf {
 
 namespace {
 
+// Raw CPUID instead of __builtin_cpu_supports: GCC < 11 has no "f16c"
+// feature name, and the probe must compile on every toolchain that can
+// build the rest of this file.
 bool cpu_has_avx2_f16c() {
     static const bool ok = [] {
         if (std::getenv("KF_NO_SIMD")) return false;
-        __builtin_cpu_init();
-        return __builtin_cpu_supports("avx2") &&
-               __builtin_cpu_supports("f16c") != 0;
+        unsigned a, b, c, d;
+        if (!__get_cpuid(1, &a, &b, &c, &d)) return false;
+        const bool f16c = (c >> 29) & 1;     // CPUID.1:ECX.F16C
+        const bool osxsave = (c >> 27) & 1;  // OS saves YMM state?
+        if (!f16c || !osxsave) return false;
+        unsigned xlo, xhi;  // xgetbv via asm: _xgetbv needs -mxsave
+        __asm__ volatile("xgetbv" : "=a"(xlo), "=d"(xhi) : "c"(0));
+        if ((xlo & 0x6) != 0x6) return false;  // XMM+YMM enabled
+        unsigned a7, b7, c7, d7;
+        if (!__get_cpuid_count(7, 0, &a7, &b7, &c7, &d7)) return false;
+        return ((b7 >> 5) & 1) != 0;         // CPUID.7.0:EBX.AVX2
     }();
     return ok;
 }

@@ -3,6 +3,7 @@
 #include "trace.hpp"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -680,6 +681,14 @@ int Server::start() {
         listen_fd_ = -1;
         return KF_ERR;
     }
+    // non-blocking listener: the accept loop is poll-driven, and a
+    // pending connection can be aborted between poll() readiness and
+    // the accept() call (accept(2) documents this race) — a BLOCKING
+    // accept would then sit past the self-pipe wakeup and hang stop().
+    // Accepted fds do not inherit the flag, so conn readers stay
+    // blocking as before.
+    ::fcntl(listen_fd_, F_SETFL,
+            ::fcntl(listen_fd_, F_GETFL, 0) | O_NONBLOCK);
     if (!unix_sockets_disabled() && ensure_sock_dir()) {
         unix_path_ = sock_path(self_);
         ::unlink(unix_path_.c_str());  // stale socket from a dead process
@@ -695,8 +704,16 @@ int Server::start() {
                         unix_path_.c_str(), std::strerror(errno));
                 ::close(unix_fd_);
                 unix_fd_ = -1;
+            } else {
+                ::fcntl(unix_fd_, F_SETFL,
+                        ::fcntl(unix_fd_, F_GETFL, 0) | O_NONBLOCK);
             }
         }
+    }
+    int wp[2];
+    if (::pipe(wp) == 0) {
+        wake_r_ = wp[0];
+        wake_w_ = wp[1];
     }
     running_ = true;
     accept_thread_ = std::thread([this] { accept_loop(listen_fd_, true); });
@@ -708,16 +725,27 @@ int Server::start() {
 
 void Server::stop() {
     if (!running_.exchange(false)) return;
+    // wake the accept loops through the self-pipe FIRST: the byte is
+    // left unread, so the level-triggered poll wakes BOTH loops however
+    // they interleave with this write (shutdown on the listeners is not
+    // enough — a listening AF_UNIX socket ignores it on Linux)
+    if (wake_w_ >= 0) {
+        char one = 1;
+        (void)!::write(wake_w_, &one, 1);
+    }
     ::shutdown(listen_fd_, SHUT_RDWR);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    if (unix_accept_thread_.joinable()) unix_accept_thread_.join();
     ::close(listen_fd_);
+    listen_fd_ = -1;
     if (unix_fd_ >= 0) {
-        ::shutdown(unix_fd_, SHUT_RDWR);
         ::close(unix_fd_);
         ::unlink(unix_path_.c_str());
         unix_fd_ = -1;
     }
-    if (accept_thread_.joinable()) accept_thread_.join();
-    if (unix_accept_thread_.joinable()) unix_accept_thread_.join();
+    if (wake_r_ >= 0) ::close(wake_r_);
+    if (wake_w_ >= 0) ::close(wake_w_);
+    wake_r_ = wake_w_ = -1;
     // kick every reader out of its blocking read, then wait for the
     // (detached) connection threads to drain
     std::unique_lock<std::mutex> lk(mu_);
@@ -742,8 +770,22 @@ void Server::set_request_handler(RequestHandler h) {
 
 void Server::accept_loop(int listen_fd, bool tcp) {
     while (running_) {
+        // poll before accept so stop() can wake this loop via the
+        // self-pipe even where shutdown() on the listener is a no-op
+        // (AF_UNIX); the wake byte stays unread => every loop wakes
+        pollfd pfds[2] = {{listen_fd, POLLIN, 0}, {wake_r_, POLLIN, 0}};
+        int pr = ::poll(pfds, wake_r_ >= 0 ? 2 : 1, 500);
+        if (pr < 0 && errno != EINTR) break;
+        if (!running_) break;
+        if (pr <= 0 || !(pfds[0].revents & POLLIN)) {
+            if (pfds[0].revents & (POLLERR | POLLHUP | POLLNVAL)) break;
+            continue;
+        }
         int fd = ::accept(listen_fd, nullptr, nullptr);
         if (fd < 0) {
+            // EAGAIN: the pending connection vanished between poll()
+            // readiness and this call — the race the non-blocking
+            // listener exists for; just go back to the poll
             if (running_) continue;
             break;
         }

@@ -273,6 +273,11 @@ class Server {
     std::atomic<bool> running_{false};
     int listen_fd_ = -1;
     int unix_fd_ = -1;  // colocated-peer listener (AF_UNIX)
+    // self-pipe waking the poll-driven accept loops: shutdown(2) on a
+    // LISTENING AF_UNIX socket is ENOTCONN on Linux and leaves a blocked
+    // accept() blocked forever, so stop() must have a wakeup channel that
+    // does not depend on socket semantics at all
+    int wake_r_ = -1, wake_w_ = -1;
     std::string unix_path_;
     std::thread accept_thread_;
     std::thread unix_accept_thread_;

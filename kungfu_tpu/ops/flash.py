@@ -667,7 +667,10 @@ def _tiles(t, causal, block_q, block_k, window=None, *, d=None,
             elif block_q > 128 and _pow2(block_q):
                 block_q //= 2
             else:
-                break
+                # cannot shrink further (non-pow2 single-block tile, or
+                # already at the 128 floor) and STILL over budget:
+                # plain attention beats handing Mosaic an OOMing tile
+                return None
     return block_q, block_k
 
 
@@ -899,8 +902,12 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, scale, block_q, block_k,
                     interpret, window=None):
     b, t, h, d = q.shape
     isz = jnp.dtype(q.dtype).itemsize
-    block_q, block_k = _tiles(t, causal, block_q, block_k, window,
-                              d=d, itemsize=isz)
+    plan = _tiles(t, causal, block_q, block_k, window, d=d, itemsize=isz)
+    assert plan is not None, (
+        "no flash tile fits the VMEM budget for this shape — the forward "
+        "pass takes the plain-attention fallback for identical arguments, "
+        "so this backward must be unreachable")
+    block_q, block_k = plan
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     qb, kb, vb = _bh(q), _bh(k), _bh(v)

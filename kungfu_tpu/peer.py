@@ -16,6 +16,7 @@ import urllib.request
 from typing import Optional, Tuple
 
 from . import env as kfenv
+from . import retrying
 from .ffi import NativePeer
 from .plan import Cluster, PeerID, PeerList
 
@@ -48,18 +49,37 @@ class Stage:
         return self.version.to_bytes(4, "little") + self.cluster.to_bytes()
 
 
-def fetch_url(url: str, timeout: float = 5.0) -> str:
-    """GET text from http(s):// or file:// URLs (tests use file://)."""
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.read().decode()
+def fetch_url(url: str, timeout: float = 5.0,
+              retry: Optional[retrying.RetryPolicy] = None) -> str:
+    """GET text from http(s):// or file:// URLs (tests use file://).
+
+    Goes through the shared control-plane retry policy (transient
+    faults backed off and logged, permanent ones raised immediately);
+    pass ``retrying.NO_RETRY`` for single-shot semantics when the
+    caller owns its own poll loop."""
+    if retry is None:
+        retry = retrying.control_plane_policy(name=f"GET {url}")
+
+    def _get() -> str:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+
+    return retry.run(_get)
 
 
-def put_url(url: str, body: str, timeout: float = 5.0) -> None:
-    req = urllib.request.Request(
-        url, data=body.encode(), method="PUT",
-        headers={"Content-Type": "application/json"},
-    )
-    urllib.request.urlopen(req, timeout=timeout).read()
+def put_url(url: str, body: str, timeout: float = 5.0,
+            retry: Optional[retrying.RetryPolicy] = None) -> None:
+    if retry is None:
+        retry = retrying.control_plane_policy(name=f"PUT {url}")
+
+    def _put() -> None:
+        req = urllib.request.Request(
+            url, data=body.encode(), method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=timeout).read()
+
+    retry.run(_put)
 
 
 class Peer:
@@ -76,6 +96,9 @@ class Peer:
         self._version = self.config.version
         self._started = False
         self._metrics = None
+        # per-phase wall times (ms) of the most recent epoch switch —
+        # the decomposition the MTTR/adaptation benchmarks publish
+        self.last_resize_phases: dict = {}
         if self.config.single_process:
             self._native = None
         else:
@@ -237,9 +260,16 @@ class Peer:
         # retry attempts FIFO-paired across peers even when they observe
         # the config server at different moments (reference:
         # peer.go:208-233 consensus-retry loop).
+        t0 = time.perf_counter()
+        fetch_s = 0.0
         while True:
+            t_round = time.perf_counter()
             try:
-                stage = Stage.from_json(fetch_url(url))
+                # single-shot fetch: this poll runs after EVERY training
+                # step, and the consensus round below already tolerates a
+                # missed fetch — backing off here would stall the step
+                stage = Stage.from_json(fetch_url(url,
+                                                  retry=retrying.NO_RETRY))
             except Exception:
                 # transient config-server error: still take part in the
                 # consensus round (peers are gated on it), voting with the
@@ -249,14 +279,26 @@ class Peer:
                 stage = Stage(self._version,
                               Cluster(runners=PeerList(),
                                       workers=self._workers))
+            fetch_s += time.perf_counter() - t_round
             if self.consensus(stage.digest(), name="kf::resize"):
                 break
             time.sleep(0.05)
+        t_consensus = time.perf_counter()
         if stage.version == self._version:
             return False, True
-        return self._propose(stage)
+        phases = {
+            # per-round fetch time vs everything else in the loop:
+            # failed rounds and the inter-round sleeps are part of the
+            # agreement wait, not of fetching
+            "fetch_ms": fetch_s * 1e3,
+            "consensus_ms": (t_consensus - t0 - fetch_s) * 1e3,
+        }
+        out = self._propose(stage)
+        self.last_resize_phases = {**phases, **self.last_resize_phases}
+        return out
 
     def _propose(self, stage: Stage) -> Tuple[bool, bool]:
+        t0 = time.perf_counter()
         new_workers = stage.cluster.workers
         keep = new_workers.rank(self.config.self_id) is not None
         if self._workers.disjoint(new_workers):
@@ -269,6 +311,7 @@ class Peer:
                 self._native.send_control(str(runner), "update", payload)
             except Exception as e:  # a dead runner must not block resize
                 print(f"[kf] notify runner {runner} failed: {e}", flush=True)
+        t_notify = time.perf_counter()
         old_workers = self._workers
         # adopt the epoch in Python state only once the native switch (and
         # the join barrier) succeeded — otherwise a failed/timed-out join
@@ -281,10 +324,75 @@ class Peer:
             # fence: leave the old epoch so stale sends fail fast
             self._native.update(str(PeerList([self.config.self_id])),
                                 stage.version)
+        t_adopt = time.perf_counter()
         self._version = stage.version
         self._workers = new_workers
         changed = not old_workers == new_workers
+        self.last_resize_phases = {
+            "notify_ms": (t_notify - t0) * 1e3,
+            "adopt_barrier_ms": (t_adopt - t_notify) * 1e3,
+        }
         return changed, keep
+
+    # -- survivor-driven failure recovery ------------------------------------
+
+    def recover_from_url(self, url: str = "", deadline_s: float = 30.0,
+                         poll=None) -> Tuple[bool, bool]:
+        """Adopt a recovery stage after a collective failed with a peer
+        death (KF_ERR_CONN) or stall-deadline trip (KF_ERR_TIMEOUT).
+
+        The normal resize path (`resize_from_url`) runs a full-cluster
+        consensus round before every switch — a dead member can never
+        vote, so that path wedges exactly when it is needed most. Here
+        the config server's monotonically versioned stage IS the
+        agreement point: the detecting runner proposes a shrunken
+        PeerList (watch.py `_propose_shrink`), every survivor polls
+        until a newer stage that still contains it appears, and adopts
+        it directly; the join barrier inside `_propose` is the fence
+        proving all survivors reached the new epoch. Deterministic
+        because the config server serializes proposals by version.
+
+        Returns (recovered, keep): `recovered` False after `deadline_s`
+        of polling (caller falls back to fail-fast); `keep` False when
+        the recovery stage evicted this worker."""
+        url = url or self.config.config_server
+        if not url or self._native is None:
+            return False, True
+        if poll is None:
+            poll = retrying.control_plane_policy(name="recover-poll",
+                                                 deadline_s=None)
+        deadline = time.monotonic() + deadline_s
+        attempt = 0
+        failed_version = None
+        while time.monotonic() < deadline:
+            try:
+                stage = Stage.from_json(
+                    fetch_url(url, retry=retrying.NO_RETRY))
+            except Exception:
+                stage = None  # server itself may be mid-restart
+            if (stage is not None and stage.version > self._version
+                    and stage.version != failed_version):
+                # _propose handles both outcomes: survivors adopt the
+                # epoch and barrier; an evicted worker fences itself
+                try:
+                    _, keep = self._propose(stage)
+                    return True, keep
+                except Exception as e:
+                    # the newer stage may still CONTAIN the dead peer (a
+                    # planned resize published just before the death) —
+                    # its join barrier can never complete. Don't retry
+                    # that version; keep polling for the detecting
+                    # runner's shrunken successor
+                    failed_version = stage.version
+                    print(
+                        f"[kf-recover] adopt of stage "
+                        f"v{stage.version} failed ({e}); polling on",
+                        flush=True,
+                    )
+            attempt += 1
+            time.sleep(min(poll.backoff_s(attempt),
+                           max(0.0, deadline - time.monotonic())))
+        return False, True
 
     def propose_new_size(self, new_size: int, url: str = ""):
         """Resize the current cluster spec and PUT it to the config server
@@ -297,4 +405,15 @@ class Peer:
         stage = Stage.from_json(fetch_url(get_url))
         new_cluster = stage.cluster.resize(new_size)
         new_stage = Stage(version=stage.version + 1, cluster=new_cluster)
-        put_url(put_target, new_stage.to_json())
+        try:
+            put_url(put_target, new_stage.to_json())
+        except Exception:
+            # the PUT may have been applied with its response lost — the
+            # retry layer then replays it and the replay is rejected as
+            # stale — so refetch to see whether the resize actually took
+            # before reporting failure
+            cur = Stage.from_json(fetch_url(get_url))
+            if cur.version >= new_stage.version and \
+                    len(cur.cluster.workers) == new_size:
+                return
+            raise

@@ -8,6 +8,7 @@ mode), -config-server, -logdir, -q, -keep, -timeout-ms.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import urllib.error
 
@@ -56,6 +57,17 @@ def main(argv=None) -> int:
                     help="don't mirror worker output to console")
     ap.add_argument("-keep", action="store_true",
                     help="watch mode: stay alive at 0 local workers")
+    ap.add_argument("-recover", action="store_true",
+                    default=os.environ.get("KF_RECOVER", "0") == "1",
+                    help="watch mode: on an unexpected worker death, "
+                         "propose a shrunken membership through the "
+                         "config server so survivors keep training "
+                         "(default from KF_RECOVER)")
+    ap.add_argument("-recovery-budget", dest="recovery_budget", type=int,
+                    default=None,
+                    help="max survivor-driven recoveries before falling "
+                         "back to fail-fast (default KF_RECOVERY_BUDGET "
+                         "or 3)")
     ap.add_argument("prog", nargs=argparse.REMAINDER,
                     help="-- program and args")
     args = ap.parse_args(argv)
@@ -135,18 +147,43 @@ def main(argv=None) -> int:
     if args.config_server:
         # seed the config server if it has no stage yet, so workers'
         # resize polls and external resize tools share one source of truth
+        from ..retrying import NO_RETRY, RetryPolicy
+
         try:
-            fetch_url(args.config_server)
+            # single-shot probe: a 404 here is the expected "unseeded"
+            # answer, not a fault to back off from
+            fetch_url(args.config_server, retry=NO_RETRY)
         except (urllib.error.URLError, urllib.error.HTTPError, OSError):
             try:
+                # generous window: runners routinely RACE their config
+                # server up (same launch script), and a server that
+                # never gets seeded serves 404 to every later resize
+                # and recovery — worth several seconds of patience
                 put_url(args.config_server.replace("/get", "/put"),
-                        stage.to_json())
+                        stage.to_json(),
+                        retry=RetryPolicy(attempts=8, base_ms=100,
+                                          max_ms=2000, deadline_s=10.0,
+                                          name="seed config server"))
             except Exception as e:
                 print(f"[kfrun] cannot seed config server: {e}",
                       file=sys.stderr)
 
     if args.watch:
         slots = hosts.slots_of(runner_id.ipv4) or args.np
+        if args.recover and not args.config_server:
+            print("[kfrun] -recover needs -config-server (the agreement "
+                  "point survivors poll); running fail-fast",
+                  file=sys.stderr)
+            # an inherited KF_RECOVER=1 would still reach the workers
+            # (spawn copies os.environ) and make them swallow the real
+            # collective error — clear it so fail-fast stays fail-fast
+            os.environ.pop("KF_RECOVER", None)
+        if args.recover and args.config_server:
+            # workers must know recovery is on (they poll instead of
+            # dying) — but ONLY when it actually is: exporting this
+            # without a config server would make workers swallow the
+            # original collective error and die with an opaque rc
+            os.environ["KF_RECOVER"] = "1"
         return watch_run(
             prog,
             runner_id,
@@ -157,7 +194,14 @@ def main(argv=None) -> int:
             logdir=args.logdir,
             quiet=args.quiet,
             keep=args.keep,
+            recover=args.recover,
+            recovery_budget=args.recovery_budget,
         )
+    # simple mode has no supervisor to propose a shrunken stage, so an
+    # inherited KF_RECOVER=1 (left over from a watch-mode run's shell)
+    # would only make workers swallow the real collective error while
+    # they poll for a recovery that can never arrive
+    os.environ.pop("KF_RECOVER", None)
     return simple_run(
         prog,
         runner_id.ipv4,

@@ -203,9 +203,19 @@ def resolve_peers_via_http(
         import urllib.error
         import urllib.request
 
+        from ..retrying import RetryPolicy
+
         out: Dict[str, int] = {}
         deadline = time.monotonic() + timeout_s
         pending = dict(hosts)
+        # shared control-plane backoff shape: early rounds poll fast
+        # (peers usually boot within ~1s of each other), later rounds
+        # back off toward 2s so a large host list doesn't hammer a
+        # still-booting peer
+        backoff = RetryPolicy(base_ms=poll_s * 1e3, max_ms=2000.0,
+                              jitter=0.25, name="self-resolve")
+        attempt = 0
+        bad_answers: Dict[str, int] = {}
         while pending:
             for host, port in list(pending.items()):
                 try:
@@ -214,13 +224,26 @@ def resolve_peers_via_http(
                             timeout=2) as resp:
                         out[host] = parse_ipv4(resp.read().decode().strip())
                         del pending[host]
-                except (urllib.error.URLError, OSError, ValueError):
+                except (urllib.error.URLError, OSError):
                     pass
+                except ValueError as e:
+                    # a truncated/empty reply from a peer killed or
+                    # restarting mid-write (exactly churn) heals on the
+                    # next round — only REPEATED garbage from a live
+                    # peer is fatal, so it surfaces before burning the
+                    # whole deadline
+                    bad_answers[host] = bad_answers.get(host, 0) + 1
+                    if bad_answers[host] >= 3:
+                        raise ValueError(
+                            f"self-resolve: bad /resolve answer from "
+                            f"{host}:{port} ({bad_answers[host]} in a "
+                            f"row): {e}") from None
             if pending:
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"self-resolve: no answer from {sorted(pending)}")
-                time.sleep(poll_s)
+                attempt += 1
+                time.sleep(backoff.backoff_s(attempt))
         # our answers are in; keep serving until each peer fetched ours
         # (best-effort: a peer that died is its own resolve failure)
         for _ in hosts:

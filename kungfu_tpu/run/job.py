@@ -17,6 +17,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from .. import chaos
 from .. import env as kfenv
 from ..plan import PeerID, PeerList
 
@@ -146,6 +147,9 @@ def spawn_worker(
     extra_env: Optional[Dict[str, str]] = None,
 ) -> Proc:
     rank = peers.rank(self_id)
+    # chaos hook: a scheduled spawn_delay fault for this rank holds the
+    # spawn here — inside the resize window — emulating a slow host
+    chaos.on_spawn(rank)
     env = dict(os.environ)
     env.update(
         _worker_env_delta(self_id, peers, version, strategy, parent,

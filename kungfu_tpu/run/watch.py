@@ -5,20 +5,33 @@ Rebuild of the reference's runner (reference: srcs/go/kungfu/runner/
 the runner port; workers (or the config server path through them) push
 "update" stages there, and the watch loop reconciles the local worker set:
 diff old/new membership, terminate departed workers, spawn joiners with a
-fresh epoch env. A worker crash (nonzero exit that wasn't an intentional
-removal) fails the whole runner fast, matching the reference's
-fail-fast-and-respawn-from-survivors model (SURVEY §5.3).
+fresh epoch env. By default a worker crash (nonzero exit that wasn't an
+intentional removal) fails the whole runner fast, matching the
+reference's fail-fast-and-respawn-from-survivors model (SURVEY §5.3).
+
+With recovery enabled (`-recover` / KF_RECOVER=1) the runner instead
+becomes the failure DETECTOR of a survivor-driven recovery loop: it
+proposes a shrunken PeerList (current stage minus the dead worker) to
+the config server, and the surviving workers — whose collectives failed
+fast with KF_ERR_CONN — poll for that stage and adopt it without the
+dead peer's vote (`Peer.recover_from_url`), restore state over the live
+resync path, and keep training. The proposal budget (`KF_RECOVERY_BUDGET`)
+bounds how many times this may happen before the runner falls back to
+fail-fast; every phase emits a KF_MTTR marker so
+`benchmarks/recovery.py` can decompose detect/consensus/restore.
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import time
 from typing import Dict, List, Optional
 
 from ..ffi import NativePeer
-from ..peer import Stage
-from ..plan import PeerID, PeerList
+from ..peer import Stage, fetch_url, put_url
+from ..plan import Cluster, PeerID, PeerList
+from ..retrying import NO_RETRY, control_plane_policy
 from .job import ChipPool, Proc, WarmPool, activate_warm, spawn_worker
 
 
@@ -81,6 +94,8 @@ class Watcher:
         logdir: str,
         quiet: bool,
         keep: bool,
+        recover: bool = False,
+        recovery_budget: Optional[int] = None,
     ):
         self.prog = prog
         self.runner_id = runner_id
@@ -89,6 +104,13 @@ class Watcher:
         self.logdir = logdir
         self.quiet = quiet
         self.keep = keep
+        # survivor-driven recovery: needs a config server (the agreement
+        # point survivors poll) — without one we can only fail fast
+        self.recover = recover and bool(config_server)
+        self.recovery_budget = (
+            int(os.environ.get("KF_RECOVERY_BUDGET", "3"))
+            if recovery_budget is None else recovery_budget)
+        self.recoveries = 0
         self.pool = ChipPool(slots)
         self.slots = slots
         # joiners activate from pre-warmed interpreters (imports already
@@ -96,6 +118,10 @@ class Watcher:
         # the bulk of round 2's ~6s resize latency (KF_PREWARM=0 opts out)
         self.warm = WarmPool(prog, target=0, quiet=True, logdir=logdir)
         self.procs: Dict[PeerID, Proc] = {}
+        # the last stage this runner APPLIED — the recovery proposal's
+        # fallback base when the config server answers 404 (restarted
+        # empty, or the boot-time seed lost its race)
+        self.last_stage: Optional[Stage] = None
         self.expected_exits: set = set()
         self.stages: "queue.Queue[Optional[Stage]]" = queue.Queue()
         self.seen_versions: set = set()
@@ -129,6 +155,7 @@ class Watcher:
         if stage.version <= self.current_version:
             return
         self.current_version = stage.version
+        self.last_stage = stage
         new_local = set(
             _local_workers(stage.cluster.workers, self.runner_id.ipv4))
         old_local = set(self.procs.keys())
@@ -171,7 +198,8 @@ class Watcher:
         )
 
     def _check_procs(self) -> Optional[int]:
-        """Reap exits. Crash (unexpected nonzero) => fail fast."""
+        """Reap exits. Crash (unexpected nonzero) => recover (when
+        enabled and within budget) or fail fast."""
         for peer, proc in list(self.procs.items()):
             code = proc.popen.poll()
             if code is None:
@@ -182,6 +210,8 @@ class Watcher:
             expected = peer in self.expected_exits
             self.expected_exits.discard(peer)
             if code != 0 and not expected:
+                if self._propose_shrink(peer, proc, code):
+                    continue
                 print(
                     f"[kfrun] worker rank {proc.rank} crashed with {code}; "
                     "failing fast",
@@ -189,6 +219,113 @@ class Watcher:
                 )
                 return code
         return None
+
+    def _propose_shrink(self, dead: PeerID, proc: Proc, code: int) -> bool:
+        """Survivor-driven recovery, detection side: publish a shrunken
+        stage (minus the dead worker) to the config server. The
+        survivors — blocked on KF_ERR_CONN — poll for it and adopt it
+        without the dead peer's vote (Peer.recover_from_url). Returns
+        False when recovery is off/over budget/impossible, which sends
+        the caller down today's fail-fast path."""
+        if not self.recover:
+            return False
+        if self.recoveries >= self.recovery_budget:
+            print(
+                f"[kfrun] recovery budget exhausted "
+                f"({self.recoveries}/{self.recovery_budget}); failing fast",
+                flush=True,
+            )
+            return False
+        t_detect = time.time()
+        print(
+            f"KF_MTTR detect t={t_detect * 1e3:.1f} rank={proc.rank} "
+            f"peer={dead} code={code}",
+            flush=True,
+        )
+        # The runner's whole propose window must END before the
+        # survivors' recovery polls give up (KF_RECOVERY_DEADLINE_MS,
+        # default 30 s) — a proposal landing after the survivors exited
+        # turns a recoverable fault into total job loss. Budget HALF the
+        # worker deadline and derive both from the same knob.
+        worker_deadline_s = float(
+            os.environ.get("KF_RECOVERY_DEADLINE_MS", "30000")) / 1e3
+        propose_deadline = time.monotonic() + min(15.0,
+                                                  worker_deadline_s / 2)
+        # fetch-modify-put with the shared backoff; a stale-version 400
+        # means another runner's proposal won the race — refetch and
+        # re-check whether the dead peer is even still a member
+        policy = control_plane_policy(name="recovery-propose",
+                                      attempts=3, deadline_s=4.0)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                stage = Stage.from_json(
+                    fetch_url(self.config_server, retry=policy))
+            except Exception as e:
+                # unreachable OR unseeded (404: the server restarted
+                # with empty state, or the boot-time seed lost its
+                # race): fall back to the last stage this runner
+                # applied — the shrunken successor then RE-SEEDS the
+                # server, healing its lost state as a side effect
+                if self.last_stage is None:
+                    print(
+                        f"[kfrun] recovery: config server unreachable "
+                        f"and no applied stage to fall back to: {e}",
+                        flush=True,
+                    )
+                    return False
+                print(
+                    f"[kfrun] recovery: config server fetch failed "
+                    f"({e}); proposing from last applied stage "
+                    f"v{self.last_stage.version}",
+                    flush=True,
+                )
+                stage = self.last_stage
+            workers = stage.cluster.workers
+            if workers.rank(dead) is None:
+                # already removed (another runner / an earlier proposal
+                # covering this death): survivors will adopt that stage.
+                # Nothing was proposed HERE, so neither the budget nor
+                # the KF_MTTR proposed marker applies
+                print(
+                    f"[kfrun] recovery: {dead} already absent from "
+                    f"stage v{stage.version}; survivors adopt that",
+                    flush=True,
+                )
+                return True
+            remaining = PeerList(w for w in workers if w != dead)
+            if not remaining:
+                print("[kfrun] recovery: no survivors to shrink to",
+                      flush=True)
+                return False
+            shrunken = Stage(
+                version=stage.version + 1,
+                cluster=Cluster(runners=stage.cluster.runners,
+                                workers=remaining),
+            )
+            try:
+                put_url(self.config_server.replace("/get", "/put"),
+                        shrunken.to_json(), retry=NO_RETRY)
+                break
+            except Exception:
+                # version race or server hiccup: refetch decides which
+                if time.monotonic() >= propose_deadline:
+                    print("[kfrun] recovery: could not publish shrunken "
+                          "stage; failing fast", flush=True)
+                    return False
+                time.sleep(min(policy.backoff_s(attempt),
+                               max(0.0, propose_deadline
+                                   - time.monotonic())))
+        self.recoveries += 1
+        print(
+            f"KF_MTTR proposed t={time.time() * 1e3:.1f} "
+            f"propose_ms={(time.time() - t_detect) * 1e3:.1f} "
+            f"survivors={len(self.procs)} local "
+            f"recovery={self.recoveries}/{self.recovery_budget}",
+            flush=True,
+        )
+        return True
 
     def run(self, initial: Optional[Stage]) -> int:
         self.control.start()
@@ -245,7 +382,10 @@ def watch_run(
     logdir: str = ".",
     quiet: bool = False,
     keep: bool = False,
+    recover: bool = False,
+    recovery_budget: Optional[int] = None,
 ) -> int:
     w = Watcher(prog, runner_id, slots, strategy, config_server, logdir,
-                quiet, keep)
+                quiet, keep, recover=recover,
+                recovery_budget=recovery_budget)
     return w.run(initial)

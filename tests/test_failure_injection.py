@@ -1,12 +1,21 @@
-"""Failure injection: mid-collective death and stale-epoch fencing.
+"""Failure injection: detection, fencing, and survivor-driven recovery.
 
-VERDICT r1 Next #9. Scenario 1: a worker dies abruptly mid-epoch; the
-survivors' blocked receives must fail fast with KF_ERR_CONN (transport
-fail_peer on collective-conn EOF) instead of blocking out their full
-timeout (reference analog: runner fail-fast, watch.go:136-149, plus
-connection.go:81-87 conn-level errors). Scenario 2: a peer evicted by an
-epoch switch keeps sending; the token fence rejects it with
-KF_ERR_EPOCH, observable from Python.
+VERDICT r1 Next #9 + the chaos-schedule recovery loop. Detection:
+a worker dies abruptly mid-epoch; the survivors' blocked receives must
+fail fast with KF_ERR_CONN (transport fail_peer on collective-conn EOF)
+instead of blocking out their full timeout (reference analog: runner
+fail-fast, watch.go:136-149, plus connection.go:81-87 conn-level
+errors). Fencing: a peer evicted by an epoch switch keeps sending; the
+token fence rejects it with KF_ERR_EPOCH, observable from Python.
+
+Recovery (the tentpole): a chaos-scheduled SIGKILL mid-training must
+end in the SURVIVORS shrinking membership through the config server,
+restoring state over the live resync path, and finishing training with
+loss continuity — no operator action (`-recover`,
+`elastic/harness.run_survivor_recovery`). Plus: a config server that
+chaos-crashes and restarts mid-training must be bridged by the shared
+retry policy, and a netns partition that HEALS within the stall
+deadline must not kill anyone (chaos/slow marker).
 """
 
 import os
@@ -95,3 +104,183 @@ def test_stale_epoch_sender_rejected():
     finally:
         for p in peers:
             p.close()
+
+
+@pytest.mark.chaos
+def test_survivor_recovery_after_chaos_worker_kill():
+    """THE acceptance scenario: a worker SIGKILLed mid-training via a
+    chaos schedule => surviving workers shrink membership, restore
+    state, continue training with loss continuity asserted, and the
+    schedule even re-grows the cluster back to target size through the
+    normal elastic path — all with zero operator action. Every phase of
+    the recovery pipeline is asserted marker-by-marker
+    (harness.RECOVERY_MARKERS)."""
+    from kungfu_tpu.elastic.harness import run_survivor_recovery
+
+    logs = run_survivor_recovery(crash_rank=1, crash_step=5,
+                                 total_steps=12, start_np=3,
+                                 port_range="27100-27999", timeout=300)
+    # the recovery epoch ran at the shrunken size...
+    assert "KF_RECOVERY_DONE rank=0 size=2" in logs, logs[-3000:]
+    # ...and the schedule healed the cluster back to 3 afterwards: the
+    # replacement joiner proved it adopted trained state, and the run
+    # completed at full size
+    assert "KF_JOINER_CONTINUITY" in logs, logs[-3000:]
+    assert "size=3 step=12" in logs, logs[-3000:]
+
+
+@pytest.mark.chaos
+def test_config_server_restart_mid_training(tmp_path):
+    """The config server chaos-crashes mid-run and restarts on the same
+    port: workers must ride the outage (resize polls tolerate the dead
+    server; proposals go through the shared retry policy) and the
+    scheduled grow must still complete after the restart."""
+    from kungfu_tpu import chaos
+    from kungfu_tpu.elastic import ConfigServer
+    from kungfu_tpu.elastic.harness import (CONTINUITY_MARKERS,
+                                            _run_continuity_cluster)
+
+    server = ConfigServer(port=0).start()
+    died = threading.Event()
+    try:
+        # the schedule lives in THIS process (the server is in-process,
+        # injected into the shared harness); the cluster's own env
+        # stays chaos-free
+        chaos.load({"faults": [
+            {"type": "die_config_server", "after_requests": 4}]})
+
+        def _resurrect():
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if server._httpd is None:
+                    died.set()
+                    time.sleep(0.5)  # a real restart is not instant
+                    chaos.load(None)
+                    server.restart()
+                    return
+                time.sleep(0.1)
+
+        t = threading.Thread(target=_resurrect, daemon=True)
+        t.start()
+        logs = _run_continuity_cluster(
+            schedule="8:2,20:3", total_steps=16, start_np=2, slots=4,
+            port_range="27100-27999", timeout=300, logdir=str(tmp_path),
+            markers=CONTINUITY_MARKERS,
+            extra_env={"KF_CHAOS": ""},  # cluster stays chaos-free
+            server=server)
+        t.join(timeout=60)
+        assert died.is_set(), "the chaos fault never killed the server"
+        # the grow proposed AFTER the outage window completed: the
+        # restarted server carried the cluster through
+        assert "size=3 step=16" in logs, logs[-3000:]
+    finally:
+        chaos.load(None)
+        server.stop()
+
+
+STEPPER_FIXED = """
+import os, time
+import numpy as np
+import kungfu_tpu
+p = kungfu_tpu.init()
+steps = int(os.environ.get("TEST_TOTAL_STEPS", "80"))
+for step in range(steps):
+    out = p.all_reduce(np.ones(64, np.float32), name=f"s{step}")
+    if step == 0:
+        print(f"rank {p.rank}/{p.size} first allreduce ok", flush=True)
+    time.sleep(0.1)
+print(f"rank {p.rank} completed {steps} steps", flush=True)
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_network_partition_heals_training_continues(tmp_path):
+    """A partition that HEALS inside the failure-detection deadline is
+    NOT a failure: both netns-backed hosts stay alive, the veth link
+    drops for ~2.5s mid-run and comes back, TCP retransmits bridge the
+    gap, and every worker completes every step with exit 0 — the
+    complement of test_multirunner's partition-kills test, proving the
+    detector doesn't fire early (chaos.FakeNet is the fault fabric)."""
+    import signal
+    import textwrap
+
+    from kungfu_tpu import chaos as kf_chaos
+
+    if not kf_chaos.netns_capable():
+        pytest.skip("needs root + CAP_NET_ADMIN for netns/veth")
+
+    REPO_ = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tag = f"kh{os.getpid() % 10000}"
+    net = kf_chaos.FakeNet(tag, subnet="10.77.41")
+    worker_py = tmp_path / "stepper.py"
+    worker_py.write_text(textwrap.dedent(STEPPER_FIXED))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ + os.pathsep + env.get("PYTHONPATH", "")
+    env["KF_LOG_LEVEL"] = "warn"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["KF_TIMEOUT_MS"] = "60000"  # the heal beats this deadline
+    env["TEST_TOTAL_STEPS"] = "80"
+    procs = []
+    try:
+        a_host = net.add_host("a")
+        b_host = net.add_host("b")
+
+        def spawn(host, logdir, outfile):
+            cmd = net.exec_prefix(host.name) + [
+                sys.executable, "-m", "kungfu_tpu.run", "-np", "4",
+                "-H", f"{a_host.ip}:2,{b_host.ip}:2", "-self", host.ip,
+                "-port-range", "30100-30999", "-logdir", str(logdir),
+                "-q", "--", sys.executable, str(worker_py)]
+            out = open(outfile, "w")
+            return subprocess.Popen(cmd, env=env, cwd=REPO_, stdout=out,
+                                    stderr=subprocess.STDOUT, text=True,
+                                    start_new_session=True), out
+
+        a, fa = spawn(a_host, tmp_path / "a", tmp_path / "a.out")
+        b, fb = spawn(b_host, tmp_path / "b", tmp_path / "b.out")
+        procs = [(a, fa), (b, fb)]
+
+        # wait for warm-up so the partition hits mid-run, not boot
+        deadline = time.time() + 90
+        logs_a = ""
+        while time.time() < deadline:
+            logs_a = "".join(
+                open(tmp_path / "a" / f).read()
+                for f in os.listdir(tmp_path / "a")
+            ) if (tmp_path / "a").exists() else ""
+            if logs_a.count("first allreduce ok") >= 2:
+                break
+            if a.poll() is not None or b.poll() is not None:
+                break
+            time.sleep(0.25)
+        assert a.poll() is None and b.poll() is None, (
+            open(tmp_path / "a.out").read(),
+            open(tmp_path / "b.out").read())
+        assert logs_a.count("first allreduce ok") >= 2, logs_a
+
+        net.partition("a")
+        time.sleep(2.5)  # well under KF_TIMEOUT_MS
+        net.heal("a")
+
+        ra = a.wait(timeout=120)
+        rb = b.wait(timeout=120)
+        logs = ""
+        for side in ("a", "b"):
+            for f in sorted(os.listdir(tmp_path / side)):
+                logs += open(tmp_path / side / f).read()
+        console = (open(tmp_path / "a.out").read()
+                   + open(tmp_path / "b.out").read())
+        assert ra == 0 and rb == 0, (ra, rb, console, logs[-3000:])
+        # every worker finished every step — no failure was declared
+        assert logs.count("completed 80 steps") == 4, logs[-3000:]
+    finally:
+        for p, f in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except Exception:
+                    p.kill()
+                p.wait(timeout=10)
+            f.close()
+        net.cleanup()
