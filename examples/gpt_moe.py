@@ -52,12 +52,15 @@ def main():
 
     tx = optax.adam(1e-2)
     opt = tx.init(params)
-    # fused head only when the mesh is a single device: the Pallas
-    # pallas_call has no GSPMD partitioning rule, so on a model-sharded
-    # multi-device mesh the partitioner would all-gather the full-batch
-    # activations into every chip (see gpt_loss_with_aux's docstring)
+    # fused head on ANY mesh: multi-device meshes vocab-shard the
+    # Pallas kernel over the model axis via shard_map and recover the
+    # exact loss with a psum-logsumexp combine (parallel/vocab_ce.py);
+    # GSPMD alone would all-gather the kernel's operands (pallas_call
+    # has no partitioning rule), which is why the old code degraded
+    # every multi-chip run to the unfused f32-logits head
     step = build_gspmd_train_step(
-        lambda p, t: gpt_loss_with_aux(model, p, t, fused=(n == 1)),
+        lambda p, t: gpt_loss_with_aux(model, p, t, fused=True,
+                                       mesh=mesh if n > 1 else None),
         tx, has_aux=True)
 
     for i in range(60):

@@ -37,8 +37,16 @@ def worker(args) -> int:
 
     p = kungfu_tpu.init()
     elastic = ElasticCallback(p, schedule=args.schedule, samples_per_step=1)
-    # A model-sized payload so the joiner broadcast cost is realistic.
-    payload = np.zeros(args.payload_mb * 2**20 // 4, dtype=np.float32)
+    # A model-sized payload with a realistic leaf structure: ~100
+    # matrix-sized leaves plus a long tail of small ones (the GPT tree
+    # shape), so the chunk schedule exercises both the single-span
+    # view path and the coalesced small-leaf tail — one flat array
+    # would make any chunking look free.
+    leaf_bytes = args.payload_mb * 2**20
+    big = [np.zeros(max(1, leaf_bytes // 100 // 4), np.float32)
+           for _ in range(100)]
+    tail = [np.zeros(64, np.float32) for _ in range(100)]
+    payload = {"big": big, "tail": tail}
     if p.config.version > 0:
         elastic.sync_position()
     resize_ms = []
@@ -56,21 +64,24 @@ def worker(args) -> int:
         if elastic.after_step():
             if not elastic.state.keep:
                 return 0  # evicted
-            payload = elastic.resync_params(payload)
+            payload = elastic.resync_params(payload,
+                                            chunk_mb=args.chunk_mb)
             ms = (time.perf_counter() - t0) * 1e3
             resize_ms.append(ms)
             # phase decomposition (VERDICT r5 item 7): where inside the
             # resize window the milliseconds actually go — the consensus
             # wait (includes the joiner's boot on a grow), the native
-            # epoch adopt + join barrier, and the state broadcast
+            # epoch adopt + join barrier, and the state resync (pack/
+            # broadcast/overlap under the chunked streaming path)
             ph = elastic.last_resize_timings
-            detail = " ".join(f"{k}={v:.1f}" for k, v in ph.items())
-            print(f"resize {old_size}->{p.size} {ms:.1f} ms | {detail}",
-                  flush=True)
+            detail = " ".join(f"{k}={v:.1f}" if isinstance(v, float)
+                              else f"{k}={v}" for k, v in ph.items())
+            print(f"resize {old_size}->{p.size} {ms:.1f} ms | "
+                  f"chunk_mb={args.chunk_mb} {detail}", flush=True)
     if p.rank == 0 and resize_ms:
         print(
             f"adaptation np0={args.np} resizes={len(resize_ms)} "
-            f"payload={args.payload_mb}MiB "
+            f"payload={args.payload_mb}MiB chunk_mb={args.chunk_mb} "
             f"mean={np.mean(resize_ms):.1f} ms "
             f"max={np.max(resize_ms):.1f} ms",
             flush=True,
@@ -78,7 +89,11 @@ def worker(args) -> int:
     return 0
 
 
-def launch(args) -> int:
+def _run_schedule(args, chunk_mb, logdir, capture: bool):
+    """Boot config server + elastic kfrun around one schedule run.
+
+    Returns the CompletedProcess (output captured when `capture`) —
+    the single launch body `launch()` and `sweep()` share."""
     import subprocess
 
     from kungfu_tpu.elastic import ConfigServer
@@ -96,15 +111,89 @@ def launch(args) -> int:
             "-np", str(args.np), "-H", f"127.0.0.1:{args.max_np}",
             "-port-range", args.port_range,
             "-w", "-config-server", server.get_url,
-            "-logdir", args.logdir,
+            "-logdir", logdir,
             "--", sys.executable, "-m", "kungfu_tpu.benchmarks.adaptation",
             "--schedule", args.schedule, "--steps", str(args.steps),
             "--payload-mb", str(args.payload_mb), "--np", str(args.np),
             "--step-ms", str(args.step_ms),
         ]
-        return subprocess.call(cmd, env=env)
+        if chunk_mb is not None:
+            cmd += ["--chunk-mb", str(chunk_mb)]
+        return subprocess.run(cmd, env=env, capture_output=capture,
+                              text=capture)
     finally:
         server.stop()
+
+
+def launch(args) -> int:
+    return _run_schedule(args, args.chunk_mb, args.logdir,
+                         capture=False).returncode
+
+
+def sweep(args) -> int:
+    """Run the resize schedule once per --chunk-mb value and publish
+    the pack/broadcast/overlap decomposition per value (0 = the
+    monolithic pack_bytes baseline). One JSON line per value, plus a
+    trailing summary — the BASELINE row for the chunked-streaming
+    resync comes from here."""
+    import json
+    import re
+
+    results = []
+    for chunk_mb in args.chunk_mb_sweep:
+        # rerun the launch body with output captured so the per-resize
+        # phase lines can be aggregated here
+        proc = _run_schedule(args, chunk_mb,
+                             f"{args.logdir}-c{chunk_mb:g}",
+                             capture=True)
+        sys.stderr.write(proc.stderr)
+        phases = []
+        # worker lines arrive through kfrun's log tee with a colored
+        # per-rank prefix, on either stream — search, don't anchor
+        for line in (proc.stdout + "\n" + proc.stderr).splitlines():
+            m = re.search(r"resize (\d+)->(\d+) ([\d.]+) ms \| (.*)", line)
+            if not m:
+                continue
+            d = {"from": int(m.group(1)), "to": int(m.group(2)),
+                 "total_ms": float(m.group(3))}
+            for kv in m.group(4).split():
+                k, _, v = kv.partition("=")
+                try:
+                    d[k] = float(v)
+                except ValueError:
+                    pass
+            phases.append(d)
+        # the grow resizes (to > from) carry the joiner broadcast —
+        # the payload-bound phase this sweep exists to decompose
+        grows = [d for d in phases if d["to"] > d["from"]]
+        agg = {}
+        for key in ("pack_ms", "broadcast_ms", "overlap_ms",
+                    "position_ms", "total_ms"):
+            vals = [d[key] for d in grows if key in d]
+            if vals:
+                agg[key] = round(float(np.mean(vals)), 1)
+        row = {"chunk_mb": chunk_mb, "resizes": len(phases),
+               "grows": len(grows), "payload_mb": args.payload_mb,
+               "rc": proc.returncode, **agg}
+        results.append(row)
+        print(json.dumps({"metric": "elastic_resync_chunk_sweep",
+                          "value": agg.get("total_ms"),
+                          "unit": "ms/grow-resize", "details": row}),
+              flush=True)
+    baseline = next((r for r in results if r["chunk_mb"] == 0), None)
+    if baseline and len(results) > 1:
+        base = baseline.get("pack_ms", 0) + baseline.get(
+            "broadcast_ms", 0)
+        for r in results:
+            if r["chunk_mb"] == 0 or not base:
+                continue
+            pb = r.get("pack_ms", 0) + r.get("broadcast_ms", 0)
+            r["pack_bcast_vs_monolithic"] = round(pb / base, 3)
+        print(json.dumps({"metric": "elastic_resync_chunk_sweep_summary",
+                          "details": results}), flush=True)
+    # any nonzero child rc fails the sweep (max() would mask a
+    # signal-killed child's negative returncode behind a 0)
+    return next((1 for r in results if r["rc"]), 0)
 
 
 def main(argv=None) -> int:
@@ -121,9 +210,22 @@ def main(argv=None) -> int:
     ap.add_argument("--step-ms", type=int, default=0,
                     help="per-step sleep emulating compute (steady-state "
                          "resizes vs boot-transient ones)")
+    ap.add_argument("--chunk-mb", type=float, default=None,
+                    help="streaming-resync chunk size in MiB (0 = the "
+                         "monolithic pack_bytes path; default = "
+                         "KF_STREAM_CHUNK_MB or the module default)")
+    ap.add_argument("--chunk-mb-sweep", dest="chunk_mb_sweep",
+                    type=lambda s: [float(x) for x in s.split(",")],
+                    default=None, metavar="0,1,4,16",
+                    help="(driver) rerun the schedule once per chunk "
+                         "size and publish the pack/broadcast/overlap "
+                         "decomposition per value (0 = monolithic "
+                         "baseline)")
     ap.add_argument("--port-range", default="27000-27999")
     ap.add_argument("--logdir", default=".kf-adaptation-logs")
     args = ap.parse_args(argv)
+    if args.chunk_mb_sweep:
+        return sweep(args)
     return launch(args) if args.launch else worker(args)
 
 

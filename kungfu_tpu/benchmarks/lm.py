@@ -139,22 +139,26 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     # (upcast) update and force a retrace inside the timed loop
     opt = tx.init(jax.tree_util.tree_map(
         lambda p: p.astype(jnp.float32), params))
+    residual = ce_variant == "residual"
+    # ce variant: "residual" (default, measured faster — 113.2k vs
+    # 105.5k tok/s at small-b12) or "recompute" (no [N, V] array at
+    # all; the long-context memory-bound variant). Every branch below
+    # runs the fused head+CE — sharded meshes vocab-shard it through
+    # parallel/vocab_ce.py (the old `fused=(n == 1)` guard silently
+    # degraded every multi-chip config to the unfused f32-logits head).
     if experts:
-        # fused head single-chip only: the Switch expert stacks are
-        # GSPMD-sharded over the "model" axis, which the pure-dp
-        # shard_map builder cannot express, so multi-chip MoE stays on
-        # the annotation-sharded path with the unfused head
+        # multi-chip MoE: GSPMD shards the Switch expert stacks over
+        # "model" while the vocab-sharded head runs via shard_map —
+        # the two compose inside one jitted step
         step = build_gspmd_train_step(
-            lambda p, t: gpt_loss_with_aux(model, p, t, fused=(n == 1)),
+            lambda p, t: gpt_loss_with_aux(
+                model, p, t, fused=True,
+                mesh=mesh if n > 1 else None),
             tx, has_aux=True)
     elif n == 1:
-        # fused head+CE (ops/fused_ce.py): "residual" (default,
-        # measured faster — 113.2k vs 105.5k tok/s at small-b12) or
-        # "recompute" (no [N, V] array at all; the long-context
-        # memory-bound variant)
         step = build_gspmd_train_step(
-            lambda p, t: gpt_fused_loss(
-                model, p, t, residual=(ce_variant == "residual")), tx)
+            lambda p, t: gpt_fused_loss(model, p, t, residual=residual),
+            tx)
     elif tp == 1:
         # multi-chip dp: shard_map keeps the fused Pallas kernel inside
         # the per-shard region (the GSPMD partitioner has no rule for
@@ -162,13 +166,16 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
         from kungfu_tpu.parallel import build_dp_replicated_train_step
 
         step = build_dp_replicated_train_step(
-            lambda p, t: gpt_fused_loss(model, p, t), tx, mesh)
+            lambda p, t: gpt_fused_loss(model, p, t, residual=residual),
+            tx, mesh)
     else:
-        # tp > 1 keeps the unfused head: the vocab-replicated lm_head
-        # runs under GSPMD with the Megatron-sharded trunk this row
-        # exists to measure
+        # tp > 1: vocab-sharded fused CE — each device owns a vocab
+        # shard of the lm_head, runs the Pallas kernel on it, and a
+        # psum-logsumexp combine recovers the exact loss (Megatron
+        # vocab-parallel loss, parallel/vocab_ce.py)
         step = build_gspmd_train_step(
-            lambda p, t: gpt_loss(model.apply({"params": p}, t), t), tx)
+            lambda p, t: gpt_fused_loss(
+                model, p, t, residual=residual, mesh=mesh), tx)
 
     def one(params, opt, tokens):
         out = step(params, opt, tokens)
@@ -208,22 +215,21 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
             iters=min(iters, 10), warmup=2)
     if remat:
         meta["remat"] = True
-    # which branches actually run the fused head (see step selection):
-    # MoE only single-chip; dense whenever tp == 1 (gspmd or dp
-    # shard_map). Label the backward variant; refuse a non-default
-    # --ce-variant on paths that never see the flag instead of
-    # mislabeling the row.
-    fused_runs = (n == 1) if experts else (tp == 1)
-    variant_plumbed = not experts and n == 1
+    # every branch runs the fused head (see step selection); the dense
+    # branches plumb --ce-variant, MoE keeps the default residual
+    # backward. Refuse a non-default --ce-variant where it is not
+    # plumbed instead of mislabeling the row.
+    variant_plumbed = not experts
     if ce_variant != "residual" and not variant_plumbed:
         raise SystemExit(
-            "--ce-variant selects the fused-CE backward, but only the "
-            "single-chip dense path plumbs it; this configuration "
-            "would run the default backward and the row would be "
-            "mislabeled")
-    if fused_runs:
-        meta["fused_ce"] = (ce_variant if variant_plumbed
-                            else "residual")
+            "--ce-variant selects the fused-CE backward, but the MoE "
+            "path does not plumb it; this configuration would run the "
+            "default backward and the row would be mislabeled")
+    meta["fused_ce"] = ce_variant if variant_plumbed else "residual"
+    if (tp > 1) or (experts and n > 1):
+        # the head is vocab-sharded over the model axis with the
+        # psum-logsumexp combine (parallel/vocab_ce.py)
+        meta["fused_ce_sharding"] = f"vocab/{tp}"
     if experts:
         from kungfu_tpu.models.gpt import effective_moe_group
 
@@ -419,6 +425,14 @@ def main():
                     help="1F1B pipeline over this many stages")
     ap.add_argument("--microbatches", type=int, default=8,
                     help="(--pp) microbatches in flight")
+    ap.add_argument("--microbatch-bound", action="store_true",
+                    help="measure the plain (non-pipelined) step at "
+                         "batch = --batch / --microbatches: the "
+                         "inherent small-batch bound on 1F1B "
+                         "throughput at the same global batch, so the "
+                         "pp=1 gap splits into inherent-microbatch "
+                         "loss vs schedule overhead (VERDICT r5 "
+                         "item 5)")
     ap.add_argument("--decode", action="store_true",
                     help="measure KV-cached generation instead of "
                          "training")
@@ -444,6 +458,42 @@ def main():
         print(json.dumps({"metric": "gpt_decode_tokens_per_sec",
                           "value": round(rate, 1),
                           "unit": "tokens/sec", "details": meta}))
+        return
+    if args.microbatch_bound:
+        # the 1F1B pipeline cuts the global batch into `microbatches`
+        # slices of b = batch/microbatches and runs each as its own
+        # fwd/bwd; a perfectly-overlapped schedule can therefore never
+        # beat the PLAIN step measured at that microbatch size. This
+        # row publishes that bound, so (plain @ global b) - (bound) is
+        # the inherent small-batch cost and (bound) - (1F1B row) is
+        # the schedule's own overhead.
+        if args.pp or args.decode:
+            raise SystemExit("--microbatch-bound is itself the "
+                             "non-pipelined reference; drop --pp/"
+                             "--decode")
+        if args.batch % args.microbatches:
+            raise SystemExit(
+                f"--microbatches {args.microbatches} must divide "
+                f"--batch {args.batch} (the pipeline's own slicing "
+                "constraint)")
+        mb = args.batch // args.microbatches
+        # plumb the full model configuration: a bound row measured on
+        # a different model (dense vs MoE, remat on/off) would make
+        # the gap decomposition wrong-by-construction
+        rate, meta = measure_lm_rate(args.size, mb, args.seq,
+                                     args.tp, args.attention,
+                                     args.iters,
+                                     experts=args.experts,
+                                     moe_group=args.moe_group,
+                                     moe_bf16=args.moe_bf16,
+                                     remat=args.remat,
+                                     ce_variant=args.ce_variant)
+        meta["global_batch"] = args.batch
+        meta["microbatches"] = args.microbatches
+        meta["microbatch"] = mb
+        print(json.dumps({"metric": "gpt_microbatch_bound_tokens_per_sec",
+                          "value": round(rate, 1), "unit": "tokens/sec",
+                          "details": meta}))
         return
     if args.pp:
         rate, meta = measure_pp_rate(args.size, args.batch, args.seq,

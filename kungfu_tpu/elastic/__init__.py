@@ -10,6 +10,7 @@ from .config_server import ConfigServer
 from .hooks import ElasticCallback, ElasticState
 from .policy import NoiseScalePolicy
 from .schedule import step_based_schedule
+from .streaming import stream_broadcast, stream_chunk_bytes
 
 __all__ = [
     "ConfigServer",
@@ -17,4 +18,6 @@ __all__ = [
     "ElasticCallback",
     "ElasticState",
     "NoiseScalePolicy",
+    "stream_broadcast",
+    "stream_chunk_bytes",
 ]

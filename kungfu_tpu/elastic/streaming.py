@@ -1,0 +1,217 @@
+"""Chunked, pipelined state streaming: the elastic resync data path.
+
+The monolithic resync (`pack_bytes -> peer.broadcast -> unpack_bytes`)
+moves a 98 MiB model through up to FOUR full host copies before a joiner
+holds it: the `np.concatenate` pack, the root-side `x.copy()` inside
+`Peer.broadcast`, the receiver's `np.empty_like` landing buffer, and the
+per-leaf `unpack` copy — measured as pack 476 ms + broadcast 1411 ms of
+the 2380 ms elastic grow 2->4 (BASELINE round 6 decomposition, VERDICT
+r5 item 7). This module replaces it with a chunked pipeline built on
+three pieces:
+
+- `ops.collective.chunk_schedule`: a deterministic partition of the
+  tree's bytes into chunks of `(leaf, offset, nbytes)` spans, computed
+  identically on every rank from shapes/dtypes alone. Large leaves
+  become single-span chunks; runs of small leaves coalesce into bounded
+  multi-span chunks.
+- `ffi.NativePeer.broadcast_inplace`: send==recv aliasing, so root
+  streams straight out of its leaf views and receivers land chunks
+  straight into their destination leaves — no model-sized staging
+  buffer exists on either side. Single-span chunks are PURE VIEWS
+  end-to-end; only the small-leaf tail passes through a <= chunk-sized
+  scratch.
+- a one-worker pipeline: the broadcast of chunk i runs on an executor
+  thread (ctypes releases the GIL) while the main thread assembles
+  chunk i+1 and scatters received multi-span chunks — packing overlaps
+  the wire instead of preceding it.
+
+The native layer further splits every chunk into ~1 MiB wire chunks
+with per-chunk strategy rotation (`Session::for_chunks`), so DCN
+behavior below this module is unchanged — the win is host copies and
+overlap, not a new wire protocol.
+
+Byte-exact by construction: the schedule covers every byte of every
+leaf exactly once in `pack_bytes` order, and bytes move as uint8 views,
+so all dtypes (ints, bools, bf16) survive bit-for-bit
+(tests/test_streaming.py holds it to `pack_bytes` equality).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Tuple
+
+import numpy as np
+
+from ..ops.collective import chunk_schedule, leaf_byte_views
+
+#: default streaming chunk size (MiB). Small enough that the tail
+#: scratch is noise next to the model, large enough that per-chunk
+#: Python overhead amortizes (the native layer re-chunks to 1 MiB for
+#: the wire either way). Override per-call or with KF_STREAM_CHUNK_MB;
+#: 0 disables streaming (callers fall back to the monolithic path).
+DEFAULT_CHUNK_MB = 4
+
+
+def stream_chunk_bytes(chunk_mb: float | None = None) -> int:
+    """Resolve the streaming chunk size in bytes: explicit argument,
+    else KF_STREAM_CHUNK_MB, else `DEFAULT_CHUNK_MB`. Returns 0 when
+    streaming is disabled (chunk size 0 or negative)."""
+    if chunk_mb is None:
+        chunk_mb = float(os.environ.get("KF_STREAM_CHUNK_MB",
+                                        DEFAULT_CHUNK_MB))
+    if chunk_mb <= 0:
+        return 0
+    return max(1, int(chunk_mb * 2**20))
+
+
+def leaf_shape_dtype(l):
+    """(shape, np.dtype) of a leaf without forcing a device->host
+    transfer for accelerator arrays; Python scalars (no .dtype) go
+    through np.asarray like pack_bytes does."""
+    dt = getattr(l, "dtype", None)
+    if dt is None:
+        a = np.asarray(l)
+        return a.shape, a.dtype
+    return np.shape(l), np.dtype(dt)
+
+
+def _host_leaves(leaves, is_root: bool):
+    """Destination buffers: on root, contiguous host views of the
+    source leaves (zero-copy for C-contiguous numpy; device arrays pay
+    their one unavoidable device->host transfer); on receivers, fresh
+    writeable buffers the chunks land into directly — the memory the
+    output tree needs anyway, not a staging copy."""
+    if is_root:
+        return [np.ascontiguousarray(np.asarray(l)) for l in leaves]
+    out = []
+    for l in leaves:
+        shape, dt = leaf_shape_dtype(l)
+        out.append(np.empty(shape, dtype=dt))
+    return out
+
+
+def stream_broadcast(peer, tree, root: int = 0,
+                     chunk_bytes: int | None = None,
+                     name: str = "kf::elastic::model") -> Tuple:
+    """Broadcast a pytree from `root` over DCN as a chunked pipeline.
+
+    Returns ``(new_tree, phases)``. `new_tree` has the exact structure/
+    shapes/dtypes of `tree` with every leaf holding root's bytes (jax
+    leaves come back as jax; numpy leaves AND Python scalars stay
+    numpy — a pure control-plane resync never initializes an
+    accelerator backend, the `unpack_bytes` discipline). `phases` decomposes the wall
+    time: ``pack_ms`` (chunk assembly + tail scatter on the main
+    thread), ``broadcast_ms`` (wire time on the executor thread),
+    ``overlap_ms`` (= pack + broadcast - wall, the time the pipeline
+    hid), ``wall_ms``, ``chunks``, ``chunk_bytes``.
+
+    Every rank must call with an identically-structured `tree` (the
+    schedule is derived from shapes/dtypes; values only matter on
+    root). `chunk_bytes` defaults to `stream_chunk_bytes()`.
+    """
+    t_wall0 = time.perf_counter()
+    if chunk_bytes is None:
+        chunk_bytes = stream_chunk_bytes()
+    if chunk_bytes <= 0:
+        raise ValueError("stream_broadcast needs chunk_bytes > 0; use "
+                         "the monolithic pack_bytes path when "
+                         "streaming is disabled")
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    phases = {"pack_ms": 0.0, "broadcast_ms": 0.0, "overlap_ms": 0.0,
+              "wall_ms": 0.0, "chunks": 0,
+              "chunk_bytes": int(chunk_bytes)}
+    if peer.size <= 1 or not leaves:
+        phases["wall_ms"] = (time.perf_counter() - t_wall0) * 1e3
+        return tree, phases
+
+    is_root = peer.rank == root
+    host = _host_leaves(leaves, is_root)
+    # host leaves are contiguous numpy, so these are pure aliases —
+    # received bytes land in the output buffers through them
+    views = leaf_byte_views(host)
+    chunks = chunk_schedule(host, chunk_bytes)
+    phases["chunks"] = len(chunks)
+
+    t_pack = 0.0
+    t_bcast = [0.0]  # accumulated on the executor thread only
+
+    def wire(buf, cname):
+        t0 = time.perf_counter()
+        peer.broadcast_inplace(buf, root=root, name=cname)
+        t_bcast[0] += time.perf_counter() - t0
+
+    def scatter(scratch, spans):
+        """Land a received multi-span scratch into the leaf views."""
+        o = 0
+        for i, off, nb in spans:
+            views[i][off:off + nb] = scratch[o:o + nb]
+            o += nb
+
+    # depth-bounded pipeline: broadcasts run in submit order on the one
+    # worker while the main thread assembles the next chunk; the bound
+    # keeps live scratch (and received-but-unscattered tails) to a few
+    # chunks instead of re-growing a model-sized backlog
+    pending: deque = deque()
+
+    def pop_one():
+        nonlocal t_pack
+        fut, scratch, spans = pending.popleft()
+        fut.result()  # surface wire errors with their chunk name
+        if not is_root and scratch is not None:
+            t0 = time.perf_counter()
+            scatter(scratch, spans)
+            t_pack += time.perf_counter() - t0
+
+    ex = ThreadPoolExecutor(max_workers=1,
+                            thread_name_prefix="kf-stream")
+    try:
+        for ci, spans in enumerate(chunks):
+            t0 = time.perf_counter()
+            if len(spans) == 1:
+                i, off, nb = spans[0]
+                buf, scratch = views[i][off:off + nb], None
+            else:
+                # small-leaf tail: bounded scratch, assembled on root,
+                # scattered on receivers after the wire completes
+                if is_root:
+                    scratch = np.concatenate(
+                        [views[i][off:off + nb] for i, off, nb in spans])
+                else:
+                    scratch = np.empty(sum(s[2] for s in spans),
+                                       np.uint8)
+                buf = scratch
+            t_pack += time.perf_counter() - t0
+            pending.append((ex.submit(wire, buf, f"{name}:c{ci}"),
+                            scratch, spans))
+            while pending and pending[0][0].done():
+                pop_one()
+            while len(pending) > 3:  # backlog: block on the oldest only
+                pop_one()
+        while pending:
+            pop_one()
+    finally:
+        ex.shutdown(wait=True)
+
+    t0 = time.perf_counter()
+    import jax.numpy as jnp
+
+    # jax leaves come back as jax (the backend already exists — the
+    # leaf proves it); everything else stays numpy, including Python
+    # scalars: jnp.asarray would downcast their int64/float64 view
+    # under default x64-disabled JAX and break byte-exactness
+    out = [jnp.asarray(h) if isinstance(l, jax.Array) else h
+           for l, h in zip(leaves, host)]
+    t_pack += time.perf_counter() - t0
+    wall = time.perf_counter() - t_wall0
+    phases["pack_ms"] = t_pack * 1e3
+    phases["broadcast_ms"] = t_bcast[0] * 1e3
+    phases["wall_ms"] = wall * 1e3
+    phases["overlap_ms"] = max(
+        0.0, (t_pack + t_bcast[0] - wall) * 1e3)
+    return jax.tree_util.tree_unflatten(treedef, out), phases

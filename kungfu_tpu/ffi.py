@@ -450,6 +450,36 @@ class NativePeer:
         )
         return out
 
+    def broadcast_inplace(self, x: np.ndarray, root: int = 0,
+                          name: str = "") -> np.ndarray:
+        """Broadcast `x` from `root` INTO `x` — zero copies on any rank.
+
+        Passes the same buffer as send and recv: `Session::broadcast`
+        skips its root-side memcpy when the pointers alias (root sends
+        straight from `x`; receivers' chunks land in place via the
+        registered `pop_into` receive). This is the streaming-resync
+        entry point — the allocating `broadcast` above pays a full
+        `x.copy()` on root plus an `np.empty_like` on every receiver,
+        which for a 98 MiB elastic payload is two redundant model-sized
+        copies (BASELINE round 6 decomposition).
+
+        `x` must be C-contiguous, and writeable on non-root ranks (the
+        received bytes overwrite it). Returns `x`.
+        """
+        if not x.flags["C_CONTIGUOUS"]:
+            raise ValueError("broadcast_inplace needs a C-contiguous "
+                             "buffer")
+        if self.rank != root and not x.flags.writeable:
+            raise ValueError("broadcast_inplace on a non-root rank "
+                             "needs a writeable buffer")
+        _check(
+            self._lib.kf_broadcast(self._h, _buf_ptr(x), _buf_ptr(x),
+                                   x.size, dtype_code(x.dtype), root,
+                                   name.encode() or b"broadcast"),
+            f"broadcast_inplace {name}",
+        )
+        return x
+
     def gather(self, x: np.ndarray, root: int = 0,
                name: str = "") -> Optional[np.ndarray]:
         x = np.ascontiguousarray(x)

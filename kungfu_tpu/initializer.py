@@ -29,13 +29,23 @@ def broadcast_variables(tree, peer=None, root: int = 0, name: str = "kf_bcast_va
     """Broadcast a pytree of arrays from `root` over the control plane.
 
     Returns the tree every rank agrees on (root's values). No-op for
-    single-worker clusters.
+    single-worker clusters. Rides the chunked streaming pipeline
+    (`elastic.streaming.stream_broadcast`) — zero-copy leaf views,
+    packing overlapped with the wire — unless KF_STREAM_CHUNK_MB=0
+    pins the monolithic pack_bytes path.
     """
     if peer is None:
         from . import peer as _default
         peer = _default()
     if peer.size <= 1:
         return tree
+    from .elastic.streaming import stream_broadcast, stream_chunk_bytes
+
+    chunk_bytes = stream_chunk_bytes()
+    if chunk_bytes > 0:
+        out, _ = stream_broadcast(peer, tree, root=root,
+                                  chunk_bytes=chunk_bytes, name=name)
+        return out
     buf = pack_bytes(tree)
     out = peer.broadcast(buf, root=root, name=name)
     return unpack_bytes(out, tree)

@@ -395,9 +395,34 @@ def gpt_loss(logits, token_ids):
         logits[:, :-1].astype(jnp.float32), token_ids[:, 1:]).mean()
 
 
+def _head_ce(hidden, params, token_ids, interpret, residual, mesh,
+             data_axis, model_axis):
+    """Shared head dispatch for the fused losses: single-device (or
+    shard_map-per-shard) kernel without `mesh`; the vocab-sharded
+    shard_map path (parallel/vocab_ce.py) when a real mesh is given."""
+    b, t, h = hidden.shape
+    flat_h = hidden[:, :-1].reshape(b * (t - 1), h)
+    flat_t = token_ids[:, 1:].reshape(-1)
+    kernel = params["lm_head"]["kernel"]
+    bias = params["lm_head"]["bias"]
+    if mesh is not None and mesh.size > 1:
+        from ..parallel.vocab_ce import vocab_sharded_fused_ce
+
+        return vocab_sharded_fused_ce(
+            flat_h, kernel, bias, flat_t, mesh=mesh,
+            data_axis=data_axis, model_axis=model_axis,
+            residual=residual, interpret=interpret)
+    from ..ops.fused_ce import fused_cross_entropy
+
+    return fused_cross_entropy(flat_h, kernel, bias, flat_t,
+                               interpret=interpret, residual=residual)
+
+
 def gpt_fused_loss(model: GPTLM, params, token_ids,
                    interpret: bool | None = None,
-                   residual: bool = True):
+                   residual: bool = True,
+                   mesh=None, data_axis: str = "data",
+                   model_axis: str = "model"):
     """`gpt_loss`, but through `ops.fused_ce.fused_cross_entropy`.
 
     Runs the trunk with `return_hidden=True` and applies the lm_head
@@ -408,26 +433,32 @@ def gpt_fused_loss(model: GPTLM, params, token_ids,
     head weights; use this for training, `gpt_loss` for eval paths
     that want the raw logits.
 
-    `interpret=None` auto-selects Pallas interpreter mode off-TPU from
-    the DEFAULT backend; pass `interpret=True` explicitly when the
-    step is jitted onto CPU devices while a TPU owns the default
-    backend (the driver's dryrun environment).
-    """
-    from ..ops.fused_ce import fused_cross_entropy
+    With `mesh` (a multi-device (data, model) Mesh) the head runs
+    VOCAB-SHARDED through `parallel.vocab_ce.vocab_sharded_fused_ce`:
+    shard_map keeps the Pallas kernel per-shard (the GSPMD partitioner
+    has no rule for pallas_call) and a psum-logsumexp combine recovers
+    the exact loss — this is how tp>1 / multi-chip keeps the
+    [B, T, V]-free loss. Without `mesh` the single-device kernel runs
+    directly (also correct inside an enclosing shard_map region, e.g.
+    `build_dp_replicated_train_step`).
 
+    `interpret=None` auto-selects Pallas interpreter mode off-TPU from
+    the DEFAULT backend (from the MESH devices when `mesh` is given);
+    pass `interpret=True` explicitly when the step is jitted onto CPU
+    devices while a TPU owns the default backend (the driver's dryrun
+    environment).
+    """
     hidden = model.apply({"params": params}, token_ids,
                          return_hidden=True)
-    b, t, h = hidden.shape
-    return fused_cross_entropy(
-        hidden[:, :-1].reshape(b * (t - 1), h),
-        params["lm_head"]["kernel"], params["lm_head"]["bias"],
-        token_ids[:, 1:].reshape(-1), interpret=interpret,
-        residual=residual)
+    return _head_ce(hidden, params, token_ids, interpret, residual,
+                    mesh, data_axis, model_axis)
 
 
 def gpt_loss_with_aux(model: GPTLM, params, token_ids,
                       fused: bool = True,
-                      interpret: bool | None = None):
+                      interpret: bool | None = None,
+                      mesh=None, data_axis: str = "data",
+                      model_axis: str = "model"):
     """(total_loss, metrics): cross entropy + the MoE router losses.
 
     Runs the model with the "losses" collection mutable, averages each
@@ -438,30 +469,29 @@ def gpt_loss_with_aux(model: GPTLM, params, token_ids,
     bare `gpt_loss` — when training an MoE config, or the router
     collapses onto few experts.
 
-    `interpret` is forwarded to `fused_cross_entropy` (fused=True only):
-    None auto-selects Pallas interpreter mode off the default backend;
-    pass True explicitly when jitting onto CPU devices while a TPU owns
-    the default backend (the driver's dryrun environment), mirroring
-    `gpt_fused_loss`.
+    `interpret` is forwarded to the fused head (fused=True only): None
+    auto-selects Pallas interpreter mode off the default backend (the
+    MESH devices when `mesh` is given); pass True explicitly when
+    jitting onto CPU devices while a TPU owns the default backend (the
+    driver's dryrun environment), mirroring `gpt_fused_loss`.
+
+    With `mesh` (a multi-device (data, model) Mesh) the fused head runs
+    VOCAB-SHARDED (`parallel.vocab_ce.vocab_sharded_fused_ce`), so
+    multi-chip MoE keeps the [B, T, V]-free loss — the GSPMD-sharded
+    expert stacks and the shard_map'd head compose inside one jitted
+    step.
     """
     c = model.config
     if fused:
         # fused head+CE (ops/fused_ce.py): bf16 head matmuls with f32
         # accumulation, no [B, T, vocab] f32 logits. `fused=False`
-        # keeps the f32 Dense head — use it under GSPMD-sharded
-        # multi-chip meshes (the pallas_call has no partitioning rule
-        # and would replicate its operands) or when f32 head numerics
-        # are required.
-        from ..ops.fused_ce import fused_cross_entropy
-
+        # keeps the f32 Dense head for when f32 head numerics are
+        # required.
         hidden, mutated = model.apply({"params": params}, token_ids,
                                       mutable=["losses"],
                                       return_hidden=True)
-        b, t, h = hidden.shape
-        ce = fused_cross_entropy(
-            hidden[:, :-1].reshape(b * (t - 1), h),
-            params["lm_head"]["kernel"], params["lm_head"]["bias"],
-            token_ids[:, 1:].reshape(-1), interpret=interpret)
+        ce = _head_ce(hidden, params, token_ids, interpret, True,
+                      mesh, data_axis, model_axis)
     else:
         logits, mutated = model.apply({"params": params}, token_ids,
                                       mutable=["losses"])

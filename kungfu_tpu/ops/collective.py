@@ -133,6 +133,83 @@ def unpack_bytes(buf, tree_like):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+# -- chunked streaming -------------------------------------------------------
+# pack_bytes above materializes the WHOLE tree as one host buffer — a
+# full extra copy of a 98 MiB model before a single byte hits the wire
+# (measured: 476 ms of the 2380 ms elastic grow 2->4, BASELINE round
+# 6). The chunk schedule below is the zero-copy replacement: large
+# leaves stream as byte-view slices (no copy on either side — the
+# receiver lands them straight into the destination leaf), runs of
+# small leaves coalesce into bounded scratch chunks. elastic/
+# streaming.py drives it as a pipelined broadcast.
+
+
+def leaf_byte_views(leaves) -> List["np.ndarray"]:
+    """Contiguous uint8 1-D views of host leaves (zero-copy for
+    C-contiguous numpy leaves; accelerator arrays pay their one
+    unavoidable device->host transfer in np.asarray)."""
+    import numpy as np
+
+    out = []
+    for l in leaves:
+        a = np.ascontiguousarray(np.asarray(l))
+        out.append(a.reshape(-1).view(np.uint8))
+    return out
+
+
+def chunk_schedule(tree_like, chunk_bytes: int) -> List[List[Tuple[int,
+                                                                   int,
+                                                                   int]]]:
+    """Partition a pytree's bytes into chunks of spans.
+
+    Returns a list of chunks; each chunk is a list of
+    ``(leaf_index, byte_offset_in_leaf, nbytes)`` spans covering every
+    byte of every leaf exactly once, in leaf order. Schedule-only —
+    derived from shapes/dtypes, so every rank computes the identical
+    schedule from its own `tree_like`.
+
+    Layout rules: a leaf of >= `chunk_bytes` closes the open chunk
+    first, so each of its FULL `chunk_bytes`-sized slices is a
+    SINGLE-span chunk (a pure view: no assembly copy on root, received
+    in place at the destination); only its sub-chunk remainder may
+    coalesce with following small leaves. Smaller leaves coalesce into
+    multi-span chunks of at most `chunk_bytes`.
+    """
+    import numpy as np
+
+    if chunk_bytes <= 0:
+        raise ValueError(f"chunk_bytes must be positive: {chunk_bytes}")
+    leaves = jax.tree_util.tree_leaves(tree_like)
+    chunks: List[List[Tuple[int, int, int]]] = []
+    cur: List[Tuple[int, int, int]] = []
+    cur_bytes = 0
+    for i, l in enumerate(leaves):
+        # same leaf tolerance as pack_bytes: Python scalars (no
+        # .dtype) count via np.asarray; arrays stay on device
+        dt = getattr(l, "dtype", None)
+        if dt is None:
+            a = np.asarray(l)
+            nbytes = int(a.size) * a.itemsize
+        else:
+            nbytes = int(np.prod(np.shape(l), dtype=np.int64)) \
+                * np.dtype(dt).itemsize
+        if nbytes >= chunk_bytes and cur:
+            chunks.append(cur)
+            cur, cur_bytes = [], 0
+        off = 0
+        while nbytes - off > 0:
+            take = min(chunk_bytes - cur_bytes, nbytes - off)
+            cur.append((i, off, take))
+            cur_bytes += take
+            off += take
+            if cur_bytes == chunk_bytes:
+                chunks.append(cur)
+                cur, cur_bytes = [], 0
+    if cur:
+        chunks.append(cur)
+    return chunks
+
+
 def subtree_shapes(tree) -> List[Tuple]:
     return [l.shape for l in jax.tree_util.tree_leaves(tree)]
 

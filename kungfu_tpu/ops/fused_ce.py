@@ -127,14 +127,19 @@ def reference_cross_entropy(hidden, kernel, bias, targets):
     """Plain-XLA fallback (and numerics oracle): same math, f32 logits.
 
     Used when shapes don't tile for the kernel (H not a multiple of
-    128); also the definition the tests hold the fused path to.
-    """
+    128); also the definition the tests hold the fused path to. Same
+    padded-row semantics as the kernels: target -1 marks a row that is
+    dropped from the mean (and so contributes zero gradient) — without
+    the mask, a fallback would silently change the loss exactly when
+    shapes stop tiling."""
     logits = jnp.dot(hidden, kernel,
                      preferred_element_type=jnp.float32)
     logits = logits + bias.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    tl = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
-    return jnp.mean(lse - tl)
+    tl = jnp.take_along_axis(logits, jnp.maximum(targets, 0)[:, None],
+                             axis=-1)[:, 0]
+    valid = (targets >= 0).astype(jnp.float32)
+    return jnp.sum((lse - tl) * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
 
 def _fwd_common(x_ref, w_ref, b_ref, t_ref, logits_ref, lse_ref, tl_ref,
@@ -283,18 +288,43 @@ def _dx_kernel(scale_ref, x_ref, w_ref, b_ref, t_ref, lse_ref, dx_ref,
         dx_ref[:] = dxacc_ref[:].astype(dx_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _fused_ce_recompute(x, w, b, t, bn, bv, interpret):
-    loss, _ = _fcr_fwd(x, w, b, t, bn, bv, interpret)
-    return loss
+# -- reusable pallas_call wrappers ------------------------------------------
+# The sharded head (parallel/vocab_ce.py) drives the SAME kernels on each
+# vocab shard, so the pallas_call plumbing is factored out of the
+# custom_vjp bodies. Sharding needs no kernel change because the target
+# column input `t` carries per-row sentinels: -1 marks a padded row (no
+# hit, zero gradient via the in-kernel `t >= 0` mask) and any value >=
+# v_pad marks a VALID row whose target lives in another vocab shard (no
+# hit — its gradient is the pure-softmax term — but `t >= 0` keeps it in
+# the loss/gradient scale).
 
 
-def _fcr_fwd(x, w, b, t, bn, bv, interpret):
+def _fwd_pallas(x, w, b, t, bn, bv, interpret, residual):
+    """Forward grid pass: (logits|None, lse, tl) for padded blocks.
+
+    `residual=True` additionally writes the bf16 logits residual the
+    residual-scheme backward consumes; otherwise only the per-row
+    online-logsumexp outputs exist.
+    """
     n_pad, h = x.shape
     v_pad = w.shape[1]
     nn, nv = n_pad // bn, v_pad // bv
-    lse, tl = pl.pallas_call(
-        functools.partial(_fwd_kernel_nores, block_v=bv),
+    kernel = _fwd_common if residual else _fwd_kernel_nores
+    out_specs = [
+        pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+    ]
+    if residual:
+        out_specs = [pl.BlockSpec((bn, bv), lambda i, j: (i, j))] \
+            + out_specs
+        out_shape = [jax.ShapeDtypeStruct((n_pad, v_pad), jnp.bfloat16)] \
+            + out_shape
+    out = pl.pallas_call(
+        functools.partial(kernel, block_v=bv),
         grid=(nn, nv),
         in_specs=[
             pl.BlockSpec((bn, h), lambda i, j: (i, 0)),
@@ -302,14 +332,8 @@ def _fcr_fwd(x, w, b, t, bn, bv, interpret):
             pl.BlockSpec((1, bv), lambda i, j: (0, j)),
             pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bn, 1), jnp.float32),   # running max
             pltpu.VMEM((bn, 1), jnp.float32),   # running sum-exp
@@ -317,20 +341,49 @@ def _fcr_fwd(x, w, b, t, bn, bv, interpret):
         ],
         interpret=interpret,
     )(x, w, b, t)
-    valid = (t >= 0).astype(jnp.float32)             # [n_pad, 1]
-    num_valid = jnp.maximum(jnp.sum(valid), 1.0)
-    loss = jnp.sum((lse - tl) * valid) / num_valid
-    return loss, (x, w, b, lse, t, num_valid)
+    if residual:
+        logits, lse, tl = out
+    else:
+        logits, (lse, tl) = None, out
+    return logits, lse, tl
 
 
-def _fcr_bwd(bn, bv, interpret, res, g):
-    x, w, b, lse, t, num_valid = res
+def _residual_d_pallas(scale, logits, lse, t, bn, bv, interpret):
+    """(d, db) of the residual scheme: d = (softmax - onehot) * scale
+    rebuilt blockwise from the bf16 logits residual (aliased in place)."""
+    n_pad, v_pad = logits.shape
+    nn, nv = n_pad // bn, v_pad // bv
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, block_v=bv),
+        grid=(nv, nn),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((bn, bv), lambda j, i: (i, j)),
+            pl.BlockSpec((bn, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda j, i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, bv), lambda j, i: (i, j)),
+            pl.BlockSpec((1, bv), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, v_pad), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, v_pad), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, bv), jnp.float32)],
+        # d overwrites the logits residual in place: same shape/dtype,
+        # consumed nowhere else
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(scale, logits, lse, t)
+
+
+def _dw_pallas(scale, x, w, b, t, lse, bn, bv, interpret):
+    """(dw, db) of the recompute scheme (fused logits rebuild)."""
     n_pad, h = x.shape
     v_pad = w.shape[1]
     nn, nv = n_pad // bn, v_pad // bv
-    scale = (g / num_valid).astype(jnp.float32)[None, None]
-
-    dw, db = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_dw_kernel, block_v=bv),
         grid=(nv, nn),
         in_specs=[
@@ -356,7 +409,13 @@ def _fcr_bwd(bn, bv, interpret, res, g):
         interpret=interpret,
     )(scale, x, w, b, t, lse)
 
-    dx = pl.pallas_call(
+
+def _dx_pallas(scale, x, w, b, t, lse, bn, bv, interpret):
+    """dx of the recompute scheme (fused logits rebuild)."""
+    n_pad, h = x.shape
+    v_pad = w.shape[1]
+    nn, nv = n_pad // bn, v_pad // bv
+    return pl.pallas_call(
         functools.partial(_dx_kernel, block_v=bv),
         grid=(nn, nv),
         in_specs=[
@@ -375,6 +434,27 @@ def _fcr_bwd(bn, bv, interpret, res, g):
         interpret=interpret,
     )(scale, x, w, b, t, lse)
 
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused_ce_recompute(x, w, b, t, bn, bv, interpret):
+    loss, _ = _fcr_fwd(x, w, b, t, bn, bv, interpret)
+    return loss
+
+
+def _fcr_fwd(x, w, b, t, bn, bv, interpret):
+    _, lse, tl = _fwd_pallas(x, w, b, t, bn, bv, interpret,
+                             residual=False)
+    valid = (t >= 0).astype(jnp.float32)             # [n_pad, 1]
+    num_valid = jnp.maximum(jnp.sum(valid), 1.0)
+    loss = jnp.sum((lse - tl) * valid) / num_valid
+    return loss, (x, w, b, lse, t, num_valid)
+
+
+def _fcr_bwd(bn, bv, interpret, res, g):
+    x, w, b, lse, t, num_valid = res
+    scale = (g / num_valid).astype(jnp.float32)[None, None]
+    dw, db = _dw_pallas(scale, x, w, b, t, lse, bn, bv, interpret)
+    dx = _dx_pallas(scale, x, w, b, t, lse, bn, bv, interpret)
     return (dx, dw, db.astype(jnp.float32),
             np.zeros(t.shape, jax.dtypes.float0))
 
@@ -389,35 +469,8 @@ def _fused_ce_padded(x, w, b, t, bn, bv, interpret):
 
 
 def _fce_fwd(x, w, b, t, bn, bv, interpret):
-    n_pad, h = x.shape
-    v_pad = w.shape[1]
-    nn, nv = n_pad // bn, v_pad // bv
-    logits, lse, tl = pl.pallas_call(
-        functools.partial(_fwd_common, block_v=bv),
-        grid=(nn, nv),
-        in_specs=[
-            pl.BlockSpec((bn, h), lambda i, j: (i, 0)),
-            pl.BlockSpec((h, bv), lambda i, j: (0, j)),
-            pl.BlockSpec((1, bv), lambda i, j: (0, j)),
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_pad, v_pad), jnp.bfloat16),
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bn, 1), jnp.float32),   # running max
-            pltpu.VMEM((bn, 1), jnp.float32),   # running sum-exp
-            pltpu.VMEM((bn, 1), jnp.float32),   # target-logit gather
-        ],
-        interpret=interpret,
-    )(x, w, b, t)
+    logits, lse, tl = _fwd_pallas(x, w, b, t, bn, bv, interpret,
+                                  residual=True)
     valid = (t >= 0).astype(jnp.float32)             # [n_pad, 1]
     num_valid = jnp.maximum(jnp.sum(valid), 1.0)
     loss = jnp.sum((lse - tl) * valid) / num_valid
@@ -426,33 +479,8 @@ def _fce_fwd(x, w, b, t, bn, bv, interpret):
 
 def _fce_bwd(bn, bv, interpret, res, g):
     x, w, logits, lse, t, num_valid = res
-    n_pad, v_pad = logits.shape
-    nn, nv = n_pad // bn, v_pad // bv
     scale = (g / num_valid).astype(jnp.float32)[None, None]
-
-    d, db = pl.pallas_call(
-        functools.partial(_bwd_kernel, block_v=bv),
-        grid=(nv, nn),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((bn, bv), lambda j, i: (i, j)),
-            pl.BlockSpec((bn, 1), lambda j, i: (i, 0)),
-            pl.BlockSpec((bn, 1), lambda j, i: (i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((bn, bv), lambda j, i: (i, j)),
-            pl.BlockSpec((1, bv), lambda j, i: (0, j)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_pad, v_pad), jnp.bfloat16),
-            jax.ShapeDtypeStruct((1, v_pad), jnp.float32),
-        ],
-        scratch_shapes=[pltpu.VMEM((1, bv), jnp.float32)],
-        # d overwrites the logits residual in place: same shape/dtype,
-        # consumed nowhere else
-        input_output_aliases={1: 0},
-        interpret=interpret,
-    )(scale, logits, lse, t)
+    d, db = _residual_d_pallas(scale, logits, lse, t, bn, bv, interpret)
 
     # dW = x^T d and dx = d W^T: plain bf16 matmuls, f32 accumulation;
     # padded rows/cols of x and d are zero so the pads contribute 0

@@ -19,7 +19,7 @@ a mesh axis), so the communication compiles onto ICI.
 """
 
 from .ada_sgd import ada_sgd
-from .fused import flatten_optimizer
+from .fused import SMALL_LEAF_ELEMS, flatten_optimizer, group_small_leaves
 from .async_sgd import PairAveragingState, pair_averaging
 from .monitors import (
     attach_gradient_noise_scale,
@@ -32,6 +32,9 @@ from .sma_sgd import sma
 from .sync_sgd import sync_sgd
 
 __all__ = [
+    "flatten_optimizer",
+    "group_small_leaves",
+    "SMALL_LEAF_ELEMS",
     "sync_sgd",
     "sma",
     "pair_averaging",

@@ -29,6 +29,7 @@ from .pipeline import (pipeline_apply, pipeline_train_step_1f1b,
                        stack_stage_params)
 from .tensor import (bert_tp_rules, gpt_moe_rules, gpt_tp_rules,
                      shard_params)
+from .vocab_ce import vocab_sharded_fused_ce
 from .train import (build_dp_replicated_train_step, build_eval_step,
                     build_gspmd_train_step, build_train_step,
                     build_train_step_with_state)
@@ -61,6 +62,7 @@ __all__ = [
     "gpt_tp_rules",
     "gpt_moe_rules",
     "shard_params",
+    "vocab_sharded_fused_ce",
     "zero1_shard_opt_state",
     "pipeline_train_step_1f1b",
     "moe_mlp",

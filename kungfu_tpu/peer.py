@@ -193,6 +193,14 @@ class Peer:
         return x.copy() if self._native is None else self._native.broadcast(
             x, root=root, name=name)
 
+    def broadcast_inplace(self, x, root=0, name=""):
+        """Broadcast from `root` INTO `x` (no copies; see
+        `NativePeer.broadcast_inplace`). Single-process: no-op.
+        Returns `x`."""
+        if self._native is not None:
+            self._native.broadcast_inplace(x, root=root, name=name)
+        return x
+
     def all_gather(self, x, name=""):
         if self._native is None:
             return x[None, ...].copy()

@@ -161,3 +161,111 @@ class TestFlattenOptimizer:
             params, opt, loss = step(params, opt, toks)
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+class TestGroupSmallLeaves:
+    """group_small_leaves: the size-thresholded middle point between
+    per-leaf updates and the (measured-negative) whole-tree flat
+    buffer — only the small-leaf tail is concatenated, large leaves
+    stay per-leaf. Must be bitwise-identical to per-leaf `inner`."""
+
+    @staticmethod
+    def _mixed_tree():
+        """A GPT-shaped mix: big 2-D projections above the threshold,
+        a long tail of layernorm/bias leaves below it, mixed dtypes."""
+        params = {
+            "wte": jnp.linspace(-1, 1, 64 * 32).reshape(64, 32
+                                                        ).astype(jnp.float32),
+            "blocks": {
+                "proj": jnp.full((48, 48), 0.2, jnp.float32),
+                "ln_scale": jnp.ones((48,), jnp.float32),
+                "ln_bias": jnp.zeros((48,), jnp.float32),
+                "bias_bf16": jnp.full((48,), 0.1, jnp.bfloat16),
+                "gain_bf16": jnp.full((16,), 0.5, jnp.bfloat16),
+            },
+        }
+        grads = jax.tree_util.tree_map(
+            lambda p: (jnp.arange(p.size).reshape(p.shape)
+                       / p.size).astype(p.dtype), params)
+        return params, grads
+
+    THRESHOLD = 1024  # big leaves: wte (2048) + proj (2304); rest tail
+
+    @pytest.mark.parametrize("make", [
+        lambda: optax.adamw(1e-3),
+        lambda: optax.sgd(0.1, momentum=0.9),
+        lambda: optax.adam(1e-2),
+    ], ids=["adamw", "sgd-momentum", "adam"])
+    def test_bitwise_parity_elementwise(self, make):
+        from kungfu_tpu.optimizers import group_small_leaves
+
+        params, grads0 = self._mixed_tree()
+        ref_tx = make()
+        grp_tx = group_small_leaves(make(), threshold=self.THRESHOLD)
+        rp = gp = params
+        rs, gs = ref_tx.init(rp), grp_tx.init(gp)
+        for step in range(4):
+            g = jax.tree_util.tree_map(lambda g: g * (step + 1), grads0)
+            ru, rs = ref_tx.update(g, rs, rp)
+            gu, gs = grp_tx.update(g, gs, gp)
+            rp = optax.apply_updates(rp, ru)
+            gp = optax.apply_updates(gp, gu)
+        for a, b in zip(jax.tree_util.tree_leaves(rp),
+                        jax.tree_util.tree_leaves(gp)):
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+
+    @pytest.mark.parametrize("threshold", [1, 10**9],
+                             ids=["all-big", "all-small"])
+    def test_degenerate_partitions_still_exact(self, threshold):
+        """threshold below every leaf (pure per-leaf) and above every
+        leaf (the whole-tree flat buffer) are both valid partitions and
+        must both stay bitwise-exact."""
+        from kungfu_tpu.optimizers import group_small_leaves
+
+        params, grads = self._mixed_tree()
+        ref_tx = optax.adamw(1e-3)
+        grp_tx = group_small_leaves(optax.adamw(1e-3),
+                                    threshold=threshold)
+        ru, _ = ref_tx.update(grads, ref_tx.init(params), params)
+        gu, _ = grp_tx.update(grads, grp_tx.init(params), params)
+        for a, b in zip(jax.tree_util.tree_leaves(ru),
+                        jax.tree_util.tree_leaves(gu)):
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+
+    def test_requires_params(self):
+        from kungfu_tpu.optimizers import group_small_leaves
+
+        params, grads = self._mixed_tree()
+        tx = group_small_leaves(optax.adamw(1e-3))
+        state = tx.init(params)
+        with pytest.raises(ValueError, match="requires params"):
+            tx.update(grads, state)
+
+    def test_works_under_jit_train_step(self):
+        """Grouped updates must trace inside a jitted train step on the
+        real GPT tree (the layernorm/bias tail concatenates, the 2-D
+        projections stay per-leaf) and train."""
+        from kungfu_tpu.models import GPTConfig, GPTLM, gpt_fused_loss
+        from kungfu_tpu.optimizers import group_small_leaves
+        from kungfu_tpu.parallel import build_gspmd_train_step
+
+        cfg = GPTConfig(vocab_size=128, hidden_size=128, num_layers=2,
+                        num_heads=4, intermediate_size=256,
+                        max_position=32)
+        model = GPTLM(cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(0), (4, 32), 0,
+                                  128)
+        params = model.init(jax.random.PRNGKey(1), toks[:1])["params"]
+        # hidden^2 = 16384 elems: a threshold of 1024 keeps every
+        # projection per-leaf while the ln scales/biases (128) group
+        tx = group_small_leaves(optax.adamw(1e-3), threshold=1024)
+        opt = tx.init(params)
+        step = build_gspmd_train_step(
+            lambda p, t: gpt_fused_loss(model, p, t), tx)
+        losses = []
+        for _ in range(5):
+            params, opt, loss = step(params, opt, toks)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
