@@ -1,0 +1,42 @@
+"""kflint — this repo's own static-analysis suite.
+
+Generic linters catch generic bugs; the hazards that actually take an
+elastic training run down at 3 a.m. are project-specific: a control-
+plane call that regressed to a bare ``except``-and-retry loop, a
+``psum`` axis name that drifted from its mesh declaration, a
+``time.time()`` smuggled into a jitted step function (breaking the
+determinism chaos replay depends on), a Pallas block plan that only
+Mosaic-OOMs at a shape nobody benchmarked, or a write to threaded
+shared state that forgot its lock. Each pass here encodes one of those
+accumulated failure classes so it is caught at lint time, before the
+recovery event.
+
+Run the suite::
+
+    python -m kungfu_tpu.analysis kungfu_tpu/
+
+Passes (see ``docs/static_analysis.md`` for the incident rationale):
+
+- ``retry-discipline``   control-plane calls must ride ``retrying.py``;
+                         bare/over-broad ``except`` is flagged
+- ``axis-consistency``   collective axis names inside ``shard_map``
+                         bodies must match the declared mesh/spec axes;
+                         spec arity must match the body where derivable
+- ``trace-purity``       no wall clocks, host RNG, or host sync inside
+                         jitted/shard_mapped step functions
+- ``vmem-budget``        flash/fused_ce block plans must fit the VMEM
+                         budget over the benchmark shape grid
+- ``lock-discipline``    writes to ``# kf: guarded_by(lock)`` state must
+                         hold the lock
+- ``unused-imports``     pyflakes-subset import hygiene (the container
+                         ships no ruff; this keeps the F401 floor)
+
+Suppression: ``# kflint: disable=<pass>[,<pass>]`` on the offending
+line (or the line above); ``# kflint: skip-file`` near the top of a
+file skips it entirely. ``unused-imports`` additionally honors
+``# noqa`` so existing re-export markers keep working.
+"""
+
+from .core import Finding, Source, all_passes, run_paths, run_source
+
+__all__ = ["Finding", "Source", "all_passes", "run_paths", "run_source"]

@@ -1,0 +1,53 @@
+"""kflint CLI: ``python -m kungfu_tpu.analysis [paths...]``.
+
+Exit status 0 when the tree is clean, 1 when any pass fired, 2 on
+usage errors — so `scripts/run-all.sh` (and CI) can gate on it like
+any other linter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import all_passes, run_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kungfu_tpu.analysis",
+        description="kflint: this repo's project-specific static-"
+                    "analysis suite (see docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*", default=["kungfu_tpu"],
+                    help="files or directories to analyze "
+                         "(default: kungfu_tpu)")
+    ap.add_argument("--select", metavar="PASS[,PASS...]",
+                    help="run only these passes")
+    ap.add_argument("--list", action="store_true", dest="list_passes",
+                    help="list available passes and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in all_passes():
+            print(f"{p.name:18s} {p.doc}")
+        return 0
+
+    select = args.select.split(",") if args.select else None
+    try:
+        findings = run_paths(args.paths or ["kungfu_tpu"], select=select)
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2  # a typo'd path must not green the gate
+    for f in findings:
+        print(f)
+    n_passes = len(select) if select else len(all_passes())
+    if findings:
+        print(f"kflint: {len(findings)} finding(s) across {n_passes} "
+              "pass(es)", file=sys.stderr)
+        return 1
+    print(f"kflint: clean ({n_passes} passes)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

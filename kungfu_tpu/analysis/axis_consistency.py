@@ -1,0 +1,252 @@
+"""axis-consistency: collective axis names must match declared axes.
+
+The hand-authored ``shard_map`` programs in ``parallel/`` (tensor,
+expert, sequence, vocab_ce, zero, pipeline) call ``psum`` /
+``all_gather`` / ``ppermute`` / ``all_to_all`` with axis names that
+must agree with the enclosing mesh/PartitionSpec declarations. A typo
+("modle" for "model") surfaces as an unbound-axis trace error at best
+— and at worst as a silently *different* reduction when the wrong but
+existing axis is named. XLA cannot catch the second case; only a
+checker that knows which axes the call site declared can.
+
+Statically derivable subset (conservative — dynamic axis names, the
+common ``axis_name`` parameter idiom, are skipped, so the pass never
+guesses):
+
+- every **string-literal** axis name passed to a collective inside a
+  ``shard_map``/``pjit`` body must appear among the axis names
+  declared by that call's ``in_specs``/``out_specs`` literals, any
+  ``Mesh(..., ("a", "b"))`` / ``axis_names=(...)`` literal in the same
+  module, or the body's own spec literals. Locally-assigned string
+  constants (``axis = "data"``) are propagated.
+- **arity**: when ``in_specs`` is a tuple literal and the body is a
+  def/lambda in the same module, the spec count must match the body's
+  positional parameter count; when ``out_specs`` is a tuple literal,
+  every ``return`` of a tuple literal must match its length. (This is
+  the derivable slice of "PartitionSpec rank matches array rank": the
+  rank mismatch Mosaic reports at trace time, the arity mismatch it
+  reports as a shape error three layers deep.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import (Finding, Source, call_name, literal_strings,
+                   scoped_calls)
+
+NAME = "axis-consistency"
+
+_COLLECTIVES = {
+    "psum", "pmax", "pmin", "pmean", "all_gather", "ppermute",
+    "all_to_all", "axis_index", "axis_size", "pbroadcast", "pswapaxes",
+}
+
+_SHARD_MAP_CALLS = {"shard_map", "jax.shard_map", "pjit", "jax.pjit"}
+
+
+def _tail(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+class _ConstStrings(ast.NodeVisitor):
+    """name -> string value for straight-line single-assignment local
+    constants; reassigned or non-literal names resolve to nothing."""
+
+    def __init__(self):
+        self.values: Dict[str, Optional[str]] = {}
+
+    def visit_Assign(self, node: ast.Assign):
+        targets = []
+        for t in node.targets:
+            if isinstance(t, ast.Tuple):
+                targets.extend(t.elts)
+            else:
+                targets.append(t)
+        if (isinstance(node.value, ast.Tuple)
+                and len(targets) == len(node.value.elts)):
+            pairs = zip(targets, node.value.elts)
+        else:
+            pairs = [(t, node.value) for t in targets]
+        for t, v in pairs:
+            if isinstance(t, ast.Name):
+                if (isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                        and t.id not in self.values):
+                    self.values[t.id] = v.value
+                else:
+                    self.values[t.id] = None  # dynamic or reassigned
+        self.generic_visit(node)
+
+
+def _mesh_axis_literals(tree: ast.AST) -> Set[str]:
+    """Axis names declared by Mesh(..., ("a", "b")) constructions or
+    axis_names=(...) keywords anywhere in the module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _tail(call_name(node))
+        kw = _kw(node, "axis_names")
+        if kw is not None:
+            out.update(literal_strings(kw))
+        if tail in ("Mesh", "make_mesh") and len(node.args) >= 2:
+            out.update(literal_strings(node.args[1]))
+    return out
+
+
+def _resolve_axis(node: ast.AST, consts: Dict[str, Optional[str]],
+                  ) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _spec_axis_names(spec_node: ast.AST,
+                     consts: Dict[str, Optional[str]]) -> Set[str]:
+    """String axis names in a P(...)/PartitionSpec(...) expression tree
+    (literals plus propagated local string constants)."""
+    out: Set[str] = set()
+    for n in ast.walk(spec_node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+        elif isinstance(n, ast.Name) and consts.get(n.id):
+            out.add(consts[n.id])
+    return out
+
+
+def _positional_arity(fn: ast.AST) -> Optional[int]:
+    """Positional parameter count of a def/lambda, or None when *args
+    (or a non-function) makes the count open-ended."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+        return None
+    a = fn.args
+    if a.vararg is not None:
+        return None
+    return len(a.posonlyargs) + len(a.args)
+
+
+def _own_returns(fn: ast.AST) -> List[ast.Return]:
+    """Return statements belonging to ``fn`` itself — nested defs have
+    their own contract and are not descended into."""
+    out: List[ast.Return] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Return):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _collect_sites(tree: ast.AST):
+    """shard_map call sites with scope-aware body resolution (see
+    core.scoped_calls)."""
+    return scoped_calls(
+        tree, lambda c: call_name(c) in _SHARD_MAP_CALLS)
+
+
+class AxisConsistencyPass:
+    name = NAME
+    doc = ("literal collective axis names inside shard_map bodies must "
+           "match declared mesh/spec axes; spec arity must match the "
+           "body where derivable")
+
+    def run(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        mesh_axes = _mesh_axis_literals(src.tree)
+        consts_v = _ConstStrings()
+        consts_v.visit(src.tree)
+        consts = consts_v.values
+
+        for call, defs in _collect_sites(src.tree):
+            findings.extend(
+                self._check_site(src, call, mesh_axes, defs, consts))
+        return findings
+
+    def _check_site(self, src: Source, call: ast.Call,
+                    mesh_axes: Set[str], defs: Dict[str, ast.AST],
+                    consts: Dict[str, Optional[str]]) -> List[Finding]:
+        findings: List[Finding] = []
+        in_specs = _kw(call, "in_specs")
+        out_specs = _kw(call, "out_specs")
+
+        declared = set(mesh_axes)
+        for spec in (in_specs, out_specs):
+            if spec is not None:
+                declared |= _spec_axis_names(spec, consts)
+
+        body: Optional[ast.AST] = None
+        if call.args:
+            first = call.args[0]
+            if isinstance(first, ast.Lambda):
+                body = first
+            elif isinstance(first, ast.Name):
+                body = defs.get(first.id)
+
+        # 1) literal axis names used by collectives in the body
+        if body is not None and declared:
+            for sub in ast.walk(body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                tail = _tail(call_name(sub))
+                if tail not in _COLLECTIVES:
+                    continue
+                axis_node = _kw(sub, "axis_name")
+                if axis_node is None and len(sub.args) >= 2:
+                    axis_node = sub.args[1]
+                if axis_node is None and tail in ("axis_index",
+                                                  "axis_size"):
+                    axis_node = sub.args[0] if sub.args else None
+                if axis_node is None:
+                    continue
+                axis = _resolve_axis(axis_node, consts)
+                if axis is not None and axis not in declared:
+                    f = src.finding(
+                        sub, NAME,
+                        f"{tail}(..., {axis!r}) inside a shard_map body "
+                        f"names an axis not declared by the call site "
+                        f"(declared: {sorted(declared)})")
+                    if f:
+                        findings.append(f)
+
+        # 2) arity: in_specs tuple vs body positional params
+        if isinstance(in_specs, ast.Tuple) and body is not None:
+            arity = _positional_arity(body)
+            if arity is not None and arity != len(in_specs.elts):
+                f = src.finding(
+                    call, NAME,
+                    f"in_specs declares {len(in_specs.elts)} spec(s) but "
+                    f"the shard_map body takes {arity} positional "
+                    "argument(s)")
+                if f:
+                    findings.append(f)
+
+        # 3) arity: out_specs tuple vs tuple-literal returns
+        if isinstance(out_specs, ast.Tuple) and isinstance(
+                body, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            want = len(out_specs.elts)
+            for sub in _own_returns(body):
+                if (isinstance(sub.value, ast.Tuple)
+                        and len(sub.value.elts) != want):
+                    f = src.finding(
+                        sub, NAME,
+                        f"body returns a {len(sub.value.elts)}-tuple but "
+                        f"out_specs declares {want} spec(s)")
+                    if f:
+                        findings.append(f)
+        return findings

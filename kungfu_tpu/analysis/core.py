@@ -1,0 +1,232 @@
+"""kflint plumbing: findings, parsed sources, suppressions, the runner.
+
+A pass is an object with a ``name``, a one-line ``doc``, and either
+``run(src: Source) -> [Finding]`` (per-file AST passes) or
+``run_global(paths) -> [Finding]`` (whole-tree passes like the VMEM
+budget check, which evaluates real plan functions instead of syntax).
+The runner handles file discovery, suppression comments, and stable
+ordering; passes only decide what is a hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set
+
+_DISABLE_RE = re.compile(r"#\s*kflint:\s*disable=([\w,-]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*kflint:\s*skip-file")
+_NOQA_RE = re.compile(r"#\s*noqa\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    pass_name: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+@dataclass
+class Source:
+    """One parsed file plus its suppression map."""
+
+    path: str
+    text: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+    _disabled: Dict[int, Set[str]] = field(default_factory=dict)
+    _comment_only: Set[int] = field(default_factory=set)
+    _noqa: Set[int] = field(default_factory=set)
+    skip: bool = False
+
+    @classmethod
+    def parse(cls, path: str, text: Optional[str] = None) -> "Source":
+        if text is None:
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+        src = cls(path=path, text=text, tree=ast.parse(text, path))
+        src.lines = text.splitlines()
+        for i, line in enumerate(src.lines, start=1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                src._disabled[i] = {p.strip() for p in m.group(1).split(",")}
+                if line.lstrip().startswith("#"):
+                    src._comment_only.add(i)
+            if _NOQA_RE.search(line):
+                src._noqa.add(i)
+            if i <= 10 and _SKIP_FILE_RE.search(line):
+                src.skip = True
+        return src
+
+    def suppressed(self, line: int, pass_name: str) -> bool:
+        """disable comments bind to their own line, or — when written
+        as a whole comment line — to the statement below. A marker
+        TRAILING statement N must not leak onto line N+1: the
+        justification covers its own line only."""
+        if pass_name in self._disabled.get(line, ()):
+            return True
+        return (line - 1 in self._comment_only
+                and pass_name in self._disabled.get(line - 1, ()))
+
+    def noqa(self, line: int) -> bool:
+        return line in self._noqa
+
+    def finding(self, node_or_line, pass_name: str,
+                message: str) -> Optional[Finding]:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        if self.suppressed(line, pass_name):
+            return None
+        return Finding(self.path, line, pass_name, message)
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    """Python files under ``paths``. A named path that does not exist,
+    or a run that collects zero files, raises — a typo'd path in a CI
+    config must fail the gate loudly, not green it by checking
+    nothing (ruff/pyflakes error on missing paths for the same
+    reason)."""
+    out = []
+    for p in paths:
+        if not os.path.exists(p):
+            raise FileNotFoundError(f"kflint: no such path: {p}")
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    if not out:
+        raise FileNotFoundError(
+            f"kflint: no Python files under: {', '.join(paths)}")
+    return out
+
+
+def all_passes() -> list:
+    # imported lazily so `import kungfu_tpu.analysis` stays cheap and
+    # dependency-light (vmem-budget pulls in jax only when it RUNS)
+    from . import (axis_consistency, lock_discipline, retry_discipline,
+                   trace_purity, unused_imports, vmem_budget)
+
+    return [
+        retry_discipline.RetryDisciplinePass(),
+        axis_consistency.AxisConsistencyPass(),
+        trace_purity.TracePurityPass(),
+        lock_discipline.LockDisciplinePass(),
+        unused_imports.UnusedImportsPass(),
+        vmem_budget.VmemBudgetPass(),
+    ]
+
+
+def _selected(passes, select: Optional[Sequence[str]]):
+    if not select:
+        return passes
+    by_name = {p.name: p for p in passes}
+    unknown = [s for s in select if s not in by_name]
+    if unknown:
+        import sys
+
+        print(f"kflint: unknown pass(es): {', '.join(unknown)} "
+              f"(known: {', '.join(sorted(by_name))})", file=sys.stderr)
+        raise SystemExit(2)  # usage error, distinct from findings (1)
+    return [by_name[s] for s in select]
+
+
+def run_source(pass_obj, text: str, path: str = "<fixture>") -> List[Finding]:
+    """Run one per-file pass over in-memory source — the fixture-test
+    entry point."""
+    src = Source.parse(path, text)
+    if src.skip:
+        return []
+    return list(pass_obj.run(src))
+
+
+def run_paths(paths: Sequence[str],
+              select: Optional[Sequence[str]] = None) -> List[Finding]:
+    passes = _selected(all_passes(), select)
+    file_passes = [p for p in passes if hasattr(p, "run")]
+    global_passes = [p for p in passes if hasattr(p, "run_global")]
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            src = Source.parse(path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(path, getattr(e, "lineno", 1) or 1,
+                                    "parse", f"cannot parse: {e}"))
+            continue
+        if src.skip:
+            continue
+        for p in file_passes:
+            findings.extend(p.run(src))
+    for p in global_passes:
+        findings.extend(p.run_global(paths))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_name))
+    return findings
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def literal_strings(node: ast.AST) -> List[str]:
+    """Every string literal anywhere under ``node``."""
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def scoped_calls(tree: ast.AST, is_match) -> list:
+    """(call, visible-defs) pairs for every Call where ``is_match(call)``
+    is true, with lexical-scope-aware name resolution: a name resolves
+    to the def visible from the call's enclosing function chain, inner
+    scopes shadowing outer (several builders in one module each define
+    their own local ``device_step`` — module-wide name maps pick the
+    wrong one, and a last-wins dict silently skips duplicates)."""
+    sites = []
+
+    def walk(node: ast.AST, scopes):
+        # scopes: outermost-first list of dicts name -> def
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.Module, ast.ClassDef)):
+            local: Dict[str, ast.AST] = {}
+            stack = list(ast.iter_child_nodes(node))
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    local[n.name] = n
+                    continue  # nested scopes resolve for themselves
+                if not isinstance(n, ast.Lambda):
+                    stack.extend(ast.iter_child_nodes(n))
+            scopes = scopes + [local]
+        if isinstance(node, ast.Call) and is_match(node):
+            visible = {}
+            for scope in scopes:  # outer first: inner shadows
+                visible.update(scope)
+            sites.append((node, visible))
+        for child in ast.iter_child_nodes(node):
+            walk(child, scopes)
+
+    walk(tree, [])
+    return sites

@@ -1,0 +1,323 @@
+"""lock-discipline: annotated shared state must be written under lock.
+
+The threaded modules (config server handlers, the streaming pipeline,
+the metrics sampler, ffi callback trampolines, the runner's chip
+allocator, the chaos engine) share mutable state across threads. The
+native side gets TSan (`scripts/sanitize.sh`); the Python side gets
+this: state declared with a trailing ``# kf: guarded_by(<lock>)``
+annotation must only be written while lexically inside a
+``with <lock>:`` block.
+
+Annotation forms (on the line that first assigns the state)::
+
+    self._stage = None        # kf: guarded_by(_lock)   (instance attr,
+                              #  lock is self._lock)
+    _active = _sentinel       # kf: guarded_by(_mu)     (module global,
+                              #  lock is module-level _mu)
+
+Checked writes: plain/augmented/annotated assignment, subscript stores,
+and the mutating container methods (append/extend/insert/remove/pop/
+clear/sort/reverse/add/discard/update/setdefault/popleft/appendleft).
+``__init__`` (for instance attrs) and module top level (for globals)
+are exempt — state born before any thread can see it needs no lock.
+Reads are NOT checked (lexical analysis cannot see happens-before
+edges like thread joins or executor shutdown); this pass is for the
+write side, where an unlocked mutation is almost never intentional.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding, Source
+
+NAME = "lock-discipline"
+
+_GUARDED_RE = re.compile(r"#\s*kf:\s*guarded_by\((\w+)\)")
+
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "clear", "sort",
+    "reverse", "add", "discard", "update", "setdefault", "popitem",
+    "popleft", "appendleft",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _annotation_on_line(src: Source, line: int) -> Optional[str]:
+    """guarded_by marker trailing the assignment, or on a pure comment
+    line directly above it (long assignments) — a trailing marker on
+    the PREVIOUS statement must not leak down."""
+    if 1 <= line <= len(src.lines):
+        m = _GUARDED_RE.search(src.lines[line - 1])
+        if m:
+            return m.group(1)
+    if 2 <= line <= len(src.lines) + 1:
+        above = src.lines[line - 2]
+        if above.lstrip().startswith("#"):
+            m = _GUARDED_RE.search(above)
+            if m:
+                return m.group(1)
+    return None
+
+
+class _Scope:
+    """Guarded names of one class (instance attrs) or the module
+    (globals): name -> lock name."""
+
+    def __init__(self):
+        self.guards: Dict[str, str] = {}
+
+
+def _with_locks(stack: List[ast.AST]) -> List[str]:
+    """QUALIFIED lock names held lexically at this point — `with
+    self._lock:` yields "self._lock", `with _mu:` yields "_mu" — so an
+    instance lock that merely shares a module lock's name can never
+    satisfy the module guard (or vice versa)."""
+    held = []
+    for node in stack:
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Name):
+                held.append(ctx.id)
+            else:
+                attr = _self_attr(ctx)
+                if attr:
+                    held.append(f"self.{attr}")
+    return held
+
+
+class LockDisciplinePass:
+    name = NAME
+    doc = ("writes to '# kf: guarded_by(lock)' state outside a "
+           "'with lock:' block")
+
+    def run(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        module_scope = _Scope()
+        # module-level annotations
+        for stmt in src.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                lock = _annotation_on_line(src, stmt.lineno)
+                if lock:
+                    for t in self._stmt_targets(stmt):
+                        if isinstance(t, ast.Name):
+                            module_scope.guards[t.id] = lock
+
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(src, node))
+            # guarded module globals are checked in EVERY function,
+            # including class methods — a method mutating chaos._active
+            # unlocked is the same hazard as a free function doing it
+            findings.extend(self._check_globals(src, node, module_scope))
+        return findings
+
+    # -- helpers ------------------------------------------------------------
+
+    @staticmethod
+    def _stmt_targets(stmt: ast.AST) -> List[ast.AST]:
+        if isinstance(stmt, ast.Assign):
+            out = []
+            for t in stmt.targets:
+                out.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+            return out
+        if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            return [stmt.target]
+        return []
+
+    def _class_guards(self, src: Source, cls: ast.ClassDef) -> _Scope:
+        scope = _Scope()
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                lock = _annotation_on_line(src, node.lineno)
+                if not lock:
+                    continue
+                for t in self._stmt_targets(node):
+                    attr = _self_attr(t)
+                    if attr:
+                        scope.guards[attr] = lock
+        return scope
+
+    def _check_class(self, src: Source,
+                     cls: ast.ClassDef) -> List[Finding]:
+        scope = self._class_guards(src, cls)
+        if not scope.guards:
+            return []
+        findings: List[Finding] = []
+        for node in cls.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name == "__init__":
+                continue  # state born before any thread can see it
+            findings.extend(self._check_writes(
+                src, node, scope,
+                name_of=_self_attr,
+                describe=lambda a: f"self.{a}",
+            ))
+        return findings
+
+    def _check_globals(self, src: Source, root: ast.AST,
+                       scope: _Scope) -> List[Finding]:
+        """Check guarded-global writes in every function under ``root``
+        (a top-level statement) — top-level code itself runs at import,
+        pre-thread, and is exempt. Each function is analyzed with its
+        own scope facts: a bare-Name assignment is a GLOBAL write only
+        under a ``global`` declaration (otherwise it binds a local that
+        merely shadows the guarded name), and container mutations are
+        skipped when the name is locally bound."""
+        if not scope.guards:
+            return []
+        findings: List[Finding] = []
+        # outermost functions only; _check_global_fn recurses from there
+        stack = [root] if isinstance(
+            root, (ast.FunctionDef, ast.AsyncFunctionDef)) else list(
+                ast.iter_child_nodes(root))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_global_fn(src, n, scope))
+            else:
+                stack.extend(ast.iter_child_nodes(n))
+        return findings
+
+    @staticmethod
+    def _fn_scope_facts(fn: ast.AST):
+        """(global_decls, local_bindings) of ``fn``'s own scope —
+        nested defs excluded, they get their own analysis."""
+        decls, bound = set(), set()
+        a = fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            bound.add(p.arg)
+        if a.vararg:
+            bound.add(a.vararg.arg)
+        if a.kwarg:
+            bound.add(a.kwarg.arg)
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(n.name)
+                continue
+            if isinstance(n, ast.Lambda):
+                continue
+            if isinstance(n, ast.Global):
+                decls.update(n.names)
+            elif isinstance(n, (ast.Name,)) and isinstance(
+                    n.ctx, ast.Store):
+                bound.add(n.id)
+            stack.extend(ast.iter_child_nodes(n))
+        return decls, bound - decls
+
+    def _check_global_fn(self, src: Source, fn: ast.AST,
+                         scope: _Scope) -> List[Finding]:
+        findings: List[Finding] = []
+        decls, local = self._fn_scope_facts(fn)
+
+        def visit(node: ast.AST, stack: List[ast.AST]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not fn:
+                    # nested def: fresh scope facts AND a fresh lock
+                    # stack — a `with lock:` around a def does not mean
+                    # the def's body runs under the lock
+                    findings.extend(
+                        self._check_global_fn(src, node, scope))
+                    return
+            if isinstance(node, ast.Lambda):
+                visit(node.body, [])  # deferred like a nested def
+                return
+            writes: List[Tuple[ast.AST, str]] = []
+            if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                for t in self._stmt_targets(node):
+                    if isinstance(t, ast.Name):
+                        # bare-name rebind: global only under `global`
+                        if t.id in scope.guards and t.id in decls:
+                            writes.append((node, t.id))
+                    elif isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name):
+                        name = t.value.id
+                        if name in scope.guards and name not in local:
+                            writes.append((node, name))
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _MUTATORS
+                  and isinstance(node.func.value, ast.Name)):
+                name = node.func.value.id
+                if name in scope.guards and name not in local:
+                    writes.append((node, name))
+            for at, name in writes:
+                lock = scope.guards[name]
+                if lock not in _with_locks(stack):
+                    f = src.finding(
+                        at, NAME,
+                        f"write to {name} (guarded_by {lock}) outside "
+                        f"'with {lock}:'")
+                    if f:
+                        findings.append(f)
+            stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child, stack)
+            stack.pop()
+
+        visit(fn, [])
+        return findings
+
+    def _check_writes(self, src: Source, fn: ast.AST, scope: _Scope,
+                      name_of, describe) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, stack: List[ast.AST]):
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+                # nested def/lambda: it runs LATER, possibly on another
+                # thread — an enclosing `with lock:` around its
+                # definition holds nothing at call time
+                body = ([node.body] if isinstance(node, ast.Lambda)
+                        else node.body)
+                for child in body:
+                    visit(child, [])
+                return
+            writes: List[Tuple[ast.AST, str]] = []
+            if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                for t in self._stmt_targets(node):
+                    base = t.value if isinstance(
+                        t, ast.Subscript) else t
+                    attr = name_of(base)
+                    if attr in scope.guards:
+                        writes.append((node, attr))
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _MUTATORS):
+                attr = name_of(node.func.value)
+                if attr in scope.guards:
+                    writes.append((node, attr))
+            for at, attr in writes:
+                lock = scope.guards[attr]
+                if f"self.{lock}" not in _with_locks(stack):
+                    f = src.finding(
+                        at, NAME,
+                        f"write to {describe(attr)} (guarded_by "
+                        f"{lock}) outside 'with self.{lock}:'")
+                    if f:
+                        findings.append(f)
+            stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child, stack)
+            stack.pop()
+
+        visit(fn, [])
+        return findings

@@ -1,0 +1,147 @@
+"""retry-discipline: control-plane calls must ride the shared policy.
+
+Round-7 (`retrying.py`) replaced every ad-hoc ``except Exception:
+retry later`` control-plane loop with one taxonomy (transient vs
+fatal), jittered backoff and a deadline — and the incidents it closed
+(synchronized retry stampedes on a restarting config server, retry
+budgets burned on malformed-JSON errors that can never heal) come
+straight back the first time a new call site regresses. This pass
+keeps the tree honest:
+
+- raw ``urllib.request.urlopen`` / ``socket.create_connection`` calls
+  anywhere outside the blessed wrapper modules (``retrying.py`` and
+  the ``fetch_url``/``put_url`` home, ``peer.py``) are flagged —
+  control-plane HTTP goes through the policy, full stop;
+- bare ``except:`` and over-broad ``except Exception`` /
+  ``except BaseException`` handlers are flagged unless the handler
+  re-raises (cleanup-then-propagate is fine), the enclosing function
+  is ``__del__`` (interpreter teardown throws anything), or the site
+  carries an explicit ``# kflint: disable=retry-discipline`` with its
+  justification — the satellite migration narrowed every other site
+  to an explicit exception list.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from .core import Finding, Source, call_name
+
+NAME = "retry-discipline"
+
+#: modules allowed to touch urllib/socket directly: the policy itself
+#: and the fetch_url/put_url wrappers every other site must use.
+_WRAPPER_MODULES = {"retrying.py", "peer.py"}
+
+_RAW_CALLS = {
+    "urllib.request.urlopen": "urlopen",
+    "urlopen": "urlopen",
+    "socket.create_connection": "socket.create_connection",
+}
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    """True when the handler contains a bare ``raise``, re-raises its
+    own bound exception, or wraps-and-propagates it (``raise X(...)
+    from e``) — cleanup/translate-then-propagate swallows nothing, so
+    broadness costs nothing."""
+    bound = handler.name
+
+    def names_bound(n):
+        return (bound and isinstance(n, ast.Name) and n.id == bound)
+
+    # the handler's OWN statements only: a `raise` inside a function
+    # merely DEFINED here runs later (if ever) and propagates nothing
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Raise):
+            if node.exc is None:
+                return True
+            if names_bound(node.exc) or names_bound(node.cause):
+                return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _broad_names(handler: ast.ExceptHandler) -> List[str]:
+    if handler.type is None:
+        return ["<bare>"]
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    out = []
+    for t in types:
+        name = t.id if isinstance(t, ast.Name) else (
+            t.attr if isinstance(t, ast.Attribute) else None)
+        if name in _BROAD:
+            out.append(name)
+    return out
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, src: Source, in_wrapper: bool):
+        self.src = src
+        self.in_wrapper = in_wrapper
+        self.findings: List[Finding] = []
+        self._func: List[str] = []  # enclosing function-name stack
+
+    def _add(self, node: ast.AST, message: str) -> None:
+        f = self.src.finding(node, NAME, message)
+        if f:
+            self.findings.append(f)
+
+    def visit_FunctionDef(self, node):
+        self._func.append(node.name)
+        self.generic_visit(node)
+        self._func.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        if not self.in_wrapper:
+            cn = call_name(node)
+            if cn in _RAW_CALLS:
+                self._add(
+                    node,
+                    f"raw {_RAW_CALLS[cn]} outside retrying.py's policy "
+                    "— use peer.fetch_url/put_url (or wrap the call in "
+                    "a RetryPolicy) so the transient/fatal taxonomy, "
+                    "backoff and deadline apply")
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self._innermost() == "__del__":
+            self.generic_visit(node)
+            return  # teardown may see anything; broad is right there
+        broad = _broad_names(node)
+        if broad and not _handler_reraises(node):
+            what = ("bare except" if broad == ["<bare>"]
+                    else f"except {'/'.join(broad)}")
+            self._add(
+                node,
+                f"{what} swallows the error taxonomy — narrow to the "
+                "exceptions this site can actually heal (see "
+                "retrying.is_transient), re-raise after cleanup, or "
+                "justify with # kflint: disable=retry-discipline")
+        self.generic_visit(node)
+
+    def _innermost(self) -> Optional[str]:
+        return self._func[-1] if self._func else None
+
+
+class RetryDisciplinePass:
+    name = NAME
+    doc = ("control-plane urllib/socket calls outside retrying.py's "
+           "policy, and bare/over-broad except handlers")
+
+    def run(self, src: Source) -> List[Finding]:
+        v = _Visitor(src, os.path.basename(src.path) in _WRAPPER_MODULES)
+        v.visit(src.tree)
+        return v.findings

@@ -1,0 +1,76 @@
+"""unused-imports: the pyflakes-F401 floor, self-hosted.
+
+The container ships no ruff/pyflakes; ``pyproject.toml`` configures
+them for machines that have them, and this pass keeps the one check
+that most often hides real bugs (a refactor that stopped using a
+module but kept importing it, masking a missing dependency edge)
+enforceable everywhere the test suite runs.
+
+Rules: a name bound by ``import`` / ``from .. import`` must be
+referenced somewhere in the module, exported via ``__all__``, or
+marked (``# noqa`` — the repo's existing re-export convention — or a
+kflint disable). ``from __future__`` imports and ``__init__.py``
+files (whose imports ARE the public API) are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from .core import Finding, Source
+
+NAME = "unused-imports"
+
+
+class UnusedImportsPass:
+    name = NAME
+    doc = "imports never referenced in their module (pyflakes F401)"
+
+    def run(self, src: Source) -> List[Finding]:
+        if os.path.basename(src.path) == "__init__.py":
+            return []  # imports are the re-export surface there
+
+        bound = []  # (local name, display name, node)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    bound.append((local, alias.name, node))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    bound.append((local, alias.name, node))
+
+        used = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                pass  # the chain's root Name is walked separately
+        # __all__ re-exports count as uses
+        for node in src.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in node.targets)):
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Constant) and isinstance(
+                            n.value, str):
+                        used.add(n.value)
+
+        findings: List[Finding] = []
+        for local, display, node in bound:
+            if local in used or src.noqa(node.lineno):
+                continue
+            f = src.finding(
+                node, NAME,
+                f"'{display}' imported but unused (re-export? mark it "
+                "# noqa)")
+            if f:
+                findings.append(f)
+        return findings

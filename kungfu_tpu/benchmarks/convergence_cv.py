@@ -31,7 +31,6 @@ def _train_resnet18(x, y, xt, yt, steps: int, batch: int, lr: float,
     """SyncSGD ResNet-18 over every visible chip; returns
     (test_accuracy, seconds, steps)."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
     import optax
 

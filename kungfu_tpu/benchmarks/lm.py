@@ -82,7 +82,7 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from kungfu_tpu.models import (GPTConfig, GPTLM, gpt_fused_loss,
-                                   gpt_loss, gpt_loss_with_aux)
+                                   gpt_loss_with_aux)
     from kungfu_tpu.parallel import (build_gspmd_train_step,
                                      gpt_moe_rules, gpt_tp_rules,
                                      shard_params)

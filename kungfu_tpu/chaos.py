@@ -109,7 +109,8 @@ class ChaosSchedule:
                 remaining=int(f.get("count", 1)),
             ))
         self._lock = threading.Lock()
-        self._http_requests = 0  # request index for die_config_server
+        # request index for die_config_server
+        self._http_requests = 0  # kf: guarded_by(_lock)
 
     @classmethod
     def from_env(cls, environ=None) -> Optional["ChaosSchedule"]:
@@ -145,34 +146,44 @@ class ChaosSchedule:
 # -- per-process engine state -------------------------------------------------
 
 _sentinel = object()
-_active = _sentinel  # lazily parsed from env; _reset() re-arms
+#: hooks fire from the step loop, config-server handler threads and the
+#: watcher at once; the lazy parse must install exactly one schedule
+_mu = threading.Lock()
+_active = _sentinel  # kf: guarded_by(_mu) — lazy; _reset() re-arms
 
 
 def active() -> Optional[ChaosSchedule]:
     """The process-wide schedule (parsed once from the environment)."""
     global _active
-    if _active is _sentinel:
-        try:
-            _active = ChaosSchedule.from_env()
-        except (ValueError, OSError, json.JSONDecodeError) as e:
-            # a malformed schedule must not take the training job down —
-            # chaos is a test instrument, not a production dependency
-            print(f"[kf-chaos] ignoring bad schedule: {e}", flush=True)
-            _active = None
-    return _active
+    if _active is not _sentinel:
+        return _active  # benign racy read: hooks see parsed-or-armed
+    with _mu:
+        if _active is _sentinel:
+            try:
+                _active = ChaosSchedule.from_env()
+            except (ValueError, OSError, json.JSONDecodeError) as e:
+                # a malformed schedule must not take the training job
+                # down — chaos is a test instrument, not a production
+                # dependency
+                print(f"[kf-chaos] ignoring bad schedule: {e}",
+                      flush=True)
+                _active = None
+        return _active
 
 
 def load(spec: Optional[Dict]) -> Optional[ChaosSchedule]:
     """Install a schedule programmatically (tests); None disarms."""
     global _active
-    _active = ChaosSchedule(spec) if spec is not None else None
-    return _active
+    with _mu:
+        _active = ChaosSchedule(spec) if spec is not None else None
+        return _active
 
 
 def _reset() -> None:
     """Forget the cached schedule so the next hook re-reads the env."""
     global _active
-    _active = _sentinel
+    with _mu:
+        _active = _sentinel
 
 
 def _fire(ftype: str, **info) -> None:
@@ -351,7 +362,7 @@ def _probe_netns() -> bool:
              "    sys.exit(0 if ok else 1)\n"],
             timeout=20, capture_output=True)
         return r.returncode == 0
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
         return False
     finally:
         # the veth pair only dies with the netns AFTER the move into it;

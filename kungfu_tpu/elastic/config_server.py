@@ -40,8 +40,9 @@ class ConfigServer:
         #: abruptly instead, so the host test survives
         self.standalone = standalone
         self._lock = threading.Lock()
-        self._stage: Optional[Stage] = None
-        self._initial: Optional[Stage] = None
+        self._stage: Optional[Stage] = None  # kf: guarded_by(_lock)
+        self._initial: Optional[Stage] = None  # kf: guarded_by(_lock)
+        # kf: guarded_by(_lock)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -179,10 +180,15 @@ class ConfigServer:
         return Handler
 
     def start(self) -> "ConfigServer":
-        self._httpd = ThreadingHTTPServer((self.host, self.port),
-                                          self._handler())
-        self.port = self._httpd.server_port  # resolves port=0
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
+        httpd = ThreadingHTTPServer((self.host, self.port),
+                                    self._handler())
+        with self._lock:
+            # under the same lock stop() swaps through — a scheduled
+            # _chaos_die stop thread racing a restart() must see either
+            # the old listener or the new one, never a torn write
+            self._httpd = httpd
+        self.port = httpd.server_port  # resolves port=0
+        self._thread = threading.Thread(target=httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
         return self

@@ -95,7 +95,12 @@ class ElasticCallback:
                 # this handler already exhausted its bounded attempts
                 self.peer.propose_new_size(want, self.config_server)
                 self._propose_failures = 0
-            except Exception as e:
+            except (RuntimeError, OSError, ValueError, KeyError,
+                    TypeError) as e:
+                # retrying.py's taxonomy: RuntimeError covers KfError,
+                # OSError the HTTP layer, ValueError/KeyError/TypeError
+                # a torn or malformed stage (int(None) is TypeError) —
+                # anything else is a bug and raises
                 self._propose_failures += 1
                 print(
                     f"[kf-elastic] propose(size={want}) gave up after "

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 from typing import Optional
 
 import numpy as np
@@ -93,13 +94,23 @@ def _check(code: int, what: str) -> int:
     return code
 
 
-_lib: Optional[ctypes.CDLL] = None
+#: first load() can race in from the peer, metrics-tick and watcher
+#: threads at once; dlopen + signature patch-up must happen exactly once
+_lib_mu = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None  # kf: guarded_by(_lib_mu)
 
 
 def load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
+        return _lib  # benign racy read: set once, never reset
+    with _lib_mu:
+        if _lib is None:
+            _lib = _bind_lib()
         return _lib
+
+
+def _bind_lib() -> ctypes.CDLL:
     lib = ctypes.CDLL(_LIB_PATH)
     P = ctypes.c_void_p
     i64 = ctypes.c_int64
@@ -156,7 +167,6 @@ def load() -> ctypes.CDLL:
         fn = getattr(lib, name)
         fn.argtypes = argtypes
         fn.restype = restype
-    _lib = lib
     return lib
 
 
@@ -244,8 +254,6 @@ class OrderGroup:
     deadlock. `schedule` is the list of task names in execution order."""
 
     def __init__(self, schedule):
-        import threading
-
         self._lib = load()
         self._names = list(schedule)
         self._index = {n: i for i, n in enumerate(self._names)}
@@ -260,8 +268,8 @@ class OrderGroup:
         # exactly the first n without touching next-cycle registrations
         # racing in from other threads.
         self._mu = threading.Lock()
-        self._cbs = []
-        self._errors = []  # (name, exception) raised inside tasks
+        self._cbs = []  # kf: guarded_by(_mu)
+        self._errors = []  # kf: guarded_by(_mu) — raised inside tasks
 
     def start(self, name: str, fn):
         """Register `fn` to run (on the executor thread) at `name`'s slot."""
@@ -271,6 +279,7 @@ class OrderGroup:
         def trampoline(_user):
             try:
                 fn()
+            # kflint: disable=retry-discipline
             except Exception as e:  # never let exceptions cross into C
                 with self._mu:
                     self._errors.append((name, e))
@@ -561,6 +570,7 @@ class NativePeer:
             payload = ctypes.string_at(data, n) if n else b""
             try:
                 fn(name.decode(), payload)
+            # kflint: disable=retry-discipline
             except Exception as e:  # never let exceptions cross into C
                 print(f"[kf] control handler error: {e}", flush=True)
 

@@ -23,7 +23,9 @@ def main():
         print(f"  simd reduce kernels: f32={simd_enabled('float32')} "
               f"f16={simd_enabled('float16')}")
         print(f"  tracing (KF_TRACE): {'on' if trace_enabled() else 'off'}")
-    except Exception as e:  # library missing is a report, not a crash
+    except (OSError, AttributeError, RuntimeError) as e:
+        # dlopen failure, missing symbol, or a probe call failing —
+        # library missing is a report, not a crash
         print(f"libkf unavailable: {e}")
     try:
         import jax
@@ -39,7 +41,7 @@ def main():
                   + ", ".join(str(d) for d in ds[:8])
                   + (" ..." if len(ds) > 8 else ""))
         print(f"process_index {jax.process_index()} / {jax.process_count()}")
-    except Exception as e:
+    except (ImportError, RuntimeError) as e:  # no jax / no backend
         print(f"jax unavailable: {e}")
     import flax
     import optax

@@ -20,8 +20,6 @@ Two paths, mirroring the framework's two planes:
 
 from __future__ import annotations
 
-from typing import Optional
-
 from .ops.collective import pack_bytes, unpack_bytes
 
 

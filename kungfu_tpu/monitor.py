@@ -30,8 +30,9 @@ class MetricsServer:
         self._threads = []
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._last = (time.monotonic(), 0, 0)
-        self._rates = (0.0, 0.0)
+        # sampled from the tick thread AND every /metrics handler thread
+        self._last = (time.monotonic(), 0, 0)  # kf: guarded_by(_lock)
+        self._rates = (0.0, 0.0)  # kf: guarded_by(_lock)
 
     def _sample(self):
         stats = self._peer.stats()
@@ -95,8 +96,8 @@ class MetricsServer:
             while not self._stop.wait(self._period):
                 try:
                     self._sample()
-                except Exception:
-                    return  # peer shut down
+                except (RuntimeError, OSError, KeyError):
+                    return  # peer shut down (KfError is a RuntimeError)
         t2 = threading.Thread(target=tick, name="kf-metrics-tick", daemon=True)
         t2.start()
         self._threads.append(t2)

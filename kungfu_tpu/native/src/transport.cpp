@@ -262,6 +262,29 @@ void Rendezvous::push(const PeerID &src, WireMessage msg) {
     cv_.notify_all();
 }
 
+// GCC-10's libtsan has no interceptor for pthread_cond_clockwait,
+// which libstdc++ uses for steady_clock waits on glibc >= 2.30: under
+// TSan the mutex release inside the wait is invisible, so the relock
+// on wakeup reports a phantom "double lock" and every cross-thread
+// edge through the condvar is lost (cascading false races). Waiting on
+// system_clock routes through the intercepted pthread_cond_timedwait.
+// pop_into re-checks state and recomputes its deadline from
+// steady_clock every iteration, so a wall-clock jump perturbs at most
+// one wakeup.
+static void cv_wait_until_steady(
+    std::condition_variable &cv, std::unique_lock<std::mutex> &lk,
+    const std::chrono::steady_clock::time_point &tp) {
+#if defined(__SANITIZE_THREAD__)
+    cv.wait_until(
+        lk, std::chrono::system_clock::now() +
+                std::chrono::duration_cast<
+                    std::chrono::system_clock::duration>(
+                    tp - std::chrono::steady_clock::now()));
+#else
+    cv.wait_until(lk, tp);
+#endif
+}
+
 Rendezvous::RecvSlot *Rendezvous::begin_recv(const PeerID &src,
                                              const std::string &name,
                                              size_t len) {
@@ -363,7 +386,7 @@ int Rendezvous::pop_into(const PeerID &src, const std::string &name,
         if (timeout_ms > 0 && deadline < wake &&
             slot.state == RecvSlot::waiting)
             wake = deadline;
-        cv_.wait_until(lk, wake);
+        cv_wait_until_steady(cv_, lk, wake);
     }
 }
 

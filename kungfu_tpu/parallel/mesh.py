@@ -16,7 +16,7 @@ so nothing is paid for the generality.
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 from typing import Optional
 
 import jax

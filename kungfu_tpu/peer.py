@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 from . import env as kfenv
 from . import retrying
 from .ffi import NativePeer
-from .plan import Cluster, PeerID, PeerList
+from .plan import Cluster, PeerList
 
 
 class Stage:
@@ -278,7 +278,9 @@ class Peer:
                 # missed fetch — backing off here would stall the step
                 stage = Stage.from_json(fetch_url(url,
                                                   retry=retrying.NO_RETRY))
-            except Exception:
+            except (OSError, ValueError, KeyError, TypeError):
+                # the taxonomy's transient faults (HTTP/socket are all
+                # OSError) plus a torn/malformed stage mid-write
                 # transient config-server error: still take part in the
                 # consensus round (peers are gated on it), voting with the
                 # current membership so the round resolves as "no change"
@@ -317,7 +319,9 @@ class Peer:
         for runner in stage.cluster.runners:
             try:
                 self._native.send_control(str(runner), "update", payload)
-            except Exception as e:  # a dead runner must not block resize
+            except (RuntimeError, OSError) as e:
+                # KfError is a RuntimeError; a dead runner must not
+                # block resize
                 print(f"[kf] notify runner {runner} failed: {e}", flush=True)
         t_notify = time.perf_counter()
         old_workers = self._workers
@@ -376,7 +380,7 @@ class Peer:
             try:
                 stage = Stage.from_json(
                     fetch_url(url, retry=retrying.NO_RETRY))
-            except Exception:
+            except (OSError, ValueError, KeyError, TypeError):
                 stage = None  # server itself may be mid-restart
             if (stage is not None and stage.version > self._version
                     and stage.version != failed_version):
@@ -385,6 +389,11 @@ class Peer:
                 try:
                     _, keep = self._propose(stage)
                     return True, keep
+                # the whole point of this loop is surviving ANY propose
+                # failure mode (native KfError, barrier timeout, HTTP,
+                # torn stage) by polling for the NEXT version — a missed
+                # exception type here would kill recovery outright
+                # kflint: disable=retry-discipline
                 except Exception as e:
                     # the newer stage may still CONTAIN the dead peer (a
                     # planned resize published just before the death) —

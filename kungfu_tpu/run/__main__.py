@@ -164,7 +164,7 @@ def main(argv=None) -> int:
                         retry=RetryPolicy(attempts=8, base_ms=100,
                                           max_ms=2000, deadline_s=10.0,
                                           name="seed config server"))
-            except Exception as e:
+            except (OSError, ValueError) as e:  # HTTP layer / bad URL
                 print(f"[kfrun] cannot seed config server: {e}",
                       file=sys.stderr)
 

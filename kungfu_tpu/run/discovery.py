@@ -200,8 +200,8 @@ def resolve_peers_via_http(
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     try:
-        import urllib.error
-        import urllib.request
+        from ..peer import fetch_url
+        from ..retrying import NO_RETRY
 
         from ..retrying import RetryPolicy
 
@@ -219,12 +219,13 @@ def resolve_peers_via_http(
         while pending:
             for host, port in list(pending.items()):
                 try:
-                    with urllib.request.urlopen(
-                            f"http://{host}:{port}/resolve",
-                            timeout=2) as resp:
-                        out[host] = parse_ipv4(resp.read().decode().strip())
-                        del pending[host]
-                except (urllib.error.URLError, OSError):
+                    # single-shot fetch (this loop owns the backoff);
+                    # the shared wrapper keeps the taxonomy in one place
+                    body = fetch_url(f"http://{host}:{port}/resolve",
+                                     timeout=2, retry=NO_RETRY)
+                    out[host] = parse_ipv4(body.strip())
+                    del pending[host]
+                except OSError:  # URLError/HTTPError both subclass it
                     pass
                 except ValueError as e:
                     # a truncated/empty reply from a peer killed or

@@ -27,7 +27,8 @@ class ChipPool:
     gpu_resource.go:17-51)."""
 
     def __init__(self, slots: int):
-        self._free = list(range(slots))
+        # grabbed by the reconcile loop and worker-exit callbacks at once
+        self._free = list(range(slots))  # kf: guarded_by(_lock)
         self._lock = threading.Lock()
 
     def get(self) -> Optional[int]:
@@ -321,13 +322,13 @@ class WarmPool:
         for p in self._warm:
             try:
                 p.stdin.close()  # EOF => prewarm exits 0
-            except Exception:
+            except (OSError, ValueError):  # dead slot / already closed
                 pass
         deadline = 2.0
         for p in self._warm:
             try:
                 p.wait(timeout=deadline)
-            except Exception:
+            except subprocess.TimeoutExpired:
                 p.kill()
         self._warm.clear()
 
@@ -368,7 +369,7 @@ def activate_warm(
         popen.stdin.write((json.dumps(env) + "\n").encode())
         popen.stdin.flush()
         popen.stdin.close()
-    except Exception:
+    except (OSError, ValueError):  # slot died / pipe already closed
         popen.kill()
         return None
     pool.mark_activation_ok()

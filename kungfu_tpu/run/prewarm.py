@@ -48,7 +48,11 @@ def main(argv=None) -> int:
         import numpy  # noqa: F401
         import jax  # noqa: F401
         import kungfu_tpu  # noqa: F401
-    except Exception as e:  # missing optional dep must not kill the slot
+    # third-party import-time side effects can raise anything; a broken
+    # optional dep must not kill the warm slot, only cost it the
+    # preimport win
+    # kflint: disable=retry-discipline
+    except Exception as e:
         print(f"prewarm: preimport skipped: {e}", file=sys.stderr)
 
     # readiness marker: WarmPool.take() prefers slots whose imports are
@@ -69,8 +73,8 @@ def main(argv=None) -> int:
 
             jax.config.update("jax_compilation_cache_dir",
                               env["JAX_COMPILATION_CACHE_DIR"])
-        except Exception:
-            pass
+        except (ImportError, AttributeError, KeyError, ValueError):
+            pass  # older jax without the config key: cold compile only
 
     if argv[0] == "-m":
         if len(argv) < 2:

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import os
 import queue
+import subprocess
 import time
 from typing import Dict, List, Optional
 
@@ -139,7 +140,8 @@ class Watcher:
             return
         try:
             stage = Stage.from_json(payload.decode())
-        except Exception as e:  # malformed update must not kill the runner
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+            # malformed update must not kill the runner
             print(f"[kfrun] bad update stage: {e}", flush=True)
             return
         # dedup: every worker notifies every runner (reference
@@ -164,7 +166,7 @@ class Watcher:
             proc.terminate()
             try:
                 proc.popen.wait(timeout=5.0)
-            except Exception:
+            except subprocess.TimeoutExpired:
                 # wedged in a native collective or trapping SIGTERM:
                 # escalate rather than hanging the reconcile loop
                 proc.kill()
@@ -262,7 +264,7 @@ class Watcher:
             try:
                 stage = Stage.from_json(
                     fetch_url(self.config_server, retry=policy))
-            except Exception as e:
+            except (OSError, ValueError, KeyError, TypeError) as e:
                 # unreachable OR unseeded (404: the server restarted
                 # with empty state, or the boot-time seed lost its
                 # race): fall back to the last stage this runner
@@ -308,7 +310,7 @@ class Watcher:
                 put_url(self.config_server.replace("/get", "/put"),
                         shrunken.to_json(), retry=NO_RETRY)
                 break
-            except Exception:
+            except (OSError, ValueError):  # 400 stale-version is OSError
                 # version race or server hiccup: refetch decides which
                 if time.monotonic() >= propose_deadline:
                     print("[kfrun] recovery: could not publish shrunken "
@@ -367,7 +369,7 @@ class Watcher:
                 try:
                     proc.popen.wait(timeout=max(0.1,
                                                 deadline - time.time()))
-                except Exception:
+                except subprocess.TimeoutExpired:
                     proc.kill()
         self.procs.clear()
 

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Sanitizer gate for the native runtime (kungfu_tpu/native).
+#
+# Builds the in-proc multi-peer smoke driver (4-peer loopback cluster:
+# concurrent named allreduce rounds, non-root broadcast, in-place
+# broadcast via send==recv aliasing inside Session::broadcast, store
+# ops, epoch switch) under each sanitizer and loops it, so the threaded
+# transport/session/peer paths — the class the round-7 Server::stop
+# hang lived in — are exercised under instrumentation, with suppression
+# files from kungfu_tpu/native/sanitize/ (policy: external roots only,
+# kf:: frames are never suppressed).
+#
+# Usage: scripts/sanitize.sh [asan|ubsan|tsan ...] [--rounds N]
+#   no flavor args = all three. Each round re-runs the full smoke on a
+#   fresh port block so leftover TIME_WAIT sockets can't alias.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+NATIVE=kungfu_tpu/native
+ROUNDS=3
+FLAVORS=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --rounds) ROUNDS="$2"; shift 2 ;;
+    asan|ubsan|tsan) FLAVORS+=("$1"); shift ;;
+    *) echo "usage: scripts/sanitize.sh [asan|ubsan|tsan ...] [--rounds N]" >&2
+       exit 2 ;;
+  esac
+done
+[ ${#FLAVORS[@]} -gt 0 ] || FLAVORS=(asan ubsan tsan)
+
+# distinct port blocks per flavor x round: 4 peers per run
+port=27100
+for flavor in "${FLAVORS[@]}"; do
+  echo "== sanitize: build $flavor (with -Werror) =="
+  make -C "$NATIVE" "smoke_test_${flavor}"
+  for round in $(seq 1 "$ROUNDS"); do
+    echo "-- $flavor round $round/$ROUNDS (base port $port)"
+    KF_SMOKE_BASE_PORT=$port make -C "$NATIVE" "${flavor}-test" \
+      || { echo "SANITIZE FAILED: $flavor round $round"; exit 1; }
+    port=$((port + 16))
+  done
+done
+
+echo "SANITIZE GREEN (${FLAVORS[*]} x $ROUNDS rounds)"

@@ -1,0 +1,537 @@
+"""kflint fixture suite: every pass fires on its positive fixture,
+stays quiet on its negative twin, and the tree itself lints clean.
+
+Fixtures are inline source strings (not files under kungfu_tpu/, which
+would trip the tree-wide assertion) run through `run_source`, the same
+entry point the CLI uses per file — so a pass that regresses to
+never-firing fails here before it silently waves hazards through.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kungfu_tpu.analysis import all_passes, run_paths, run_source
+from kungfu_tpu.analysis.axis_consistency import AxisConsistencyPass
+from kungfu_tpu.analysis.lock_discipline import LockDisciplinePass
+from kungfu_tpu.analysis.retry_discipline import RetryDisciplinePass
+from kungfu_tpu.analysis.trace_purity import TracePurityPass
+from kungfu_tpu.analysis.unused_imports import UnusedImportsPass
+from kungfu_tpu.analysis import vmem_budget
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "kungfu_tpu")
+
+
+def fire(pass_obj, src):
+    return run_source(pass_obj, textwrap.dedent(src))
+
+
+# -- retry-discipline --------------------------------------------------------
+
+
+def test_retry_fires_on_bare_and_broad_except():
+    findings = fire(RetryDisciplinePass(), """
+        def poll():
+            try:
+                step()
+            except:
+                pass
+
+        def poll2():
+            try:
+                step()
+            except Exception:
+                return None
+    """)
+    assert len(findings) == 2
+    assert all(f.pass_name == "retry-discipline" for f in findings)
+
+
+def test_retry_fires_on_raw_urlopen():
+    findings = fire(RetryDisciplinePass(), """
+        import urllib.request
+
+        def fetch(url):
+            return urllib.request.urlopen(url).read()
+    """)
+    assert len(findings) == 1
+    assert "urlopen" in findings[0].message
+
+
+def test_retry_quiet_on_narrow_reraise_del_and_disable():
+    findings = fire(RetryDisciplinePass(), """
+        def narrow():
+            try:
+                step()
+            except (OSError, ValueError):
+                pass
+
+        def cleanup_then_propagate():
+            try:
+                step()
+            except Exception:
+                undo()
+                raise
+
+        class C:
+            def __del__(self):
+                try:
+                    self.close()
+                except Exception:
+                    pass
+
+        def justified():
+            try:
+                step()
+            # kflint: disable=retry-discipline
+            except Exception:
+                pass
+    """)
+    assert findings == []
+
+
+def test_retry_fires_when_raise_is_only_in_a_nested_def():
+    # a `raise` inside a function merely DEFINED by the handler runs
+    # later (if ever) — the handler itself still swallows
+    findings = fire(RetryDisciplinePass(), """
+        def swallow_but_define(cbs):
+            try:
+                step()
+            except Exception:
+                def cb():
+                    raise
+                cbs.append(cb)
+    """)
+    assert len(findings) == 1
+
+
+def test_trace_call_form_partial_static_argnames():
+    # partial(jax.jit, static_argnames=...)(fn): the static markers
+    # live on the inner partial call — `causal` is NOT a tracer
+    findings = fire(TracePurityPass(), """
+        import functools
+        import jax
+
+        def masked(x, causal):
+            if causal:
+                return x * 2
+            return x
+
+        step = functools.partial(
+            jax.jit, static_argnames=("causal",))(masked)
+    """)
+    assert findings == []
+
+
+def test_retry_quiet_on_wrap_and_propagate():
+    findings = fire(RetryDisciplinePass(), """
+        def translate():
+            try:
+                step()
+            except Exception as e:
+                raise RuntimeError("step failed") from e
+    """)
+    assert findings == []
+
+
+def test_disable_marker_does_not_leak_to_next_line():
+    findings = fire(RetryDisciplinePass(), """
+        import urllib.request
+
+        def two_fetches(url):
+            a = urllib.request.urlopen(url)  # kflint: disable=retry-discipline
+            b = urllib.request.urlopen(url)
+            return a, b
+    """)
+    assert len(findings) == 1  # only the UNjustified second call
+
+
+# -- axis-consistency --------------------------------------------------------
+
+
+def test_axis_fires_on_undeclared_literal_axis():
+    findings = fire(AxisConsistencyPass(), """
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return lax.psum(x, "modle")  # typo
+
+        def build(mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("model"),),
+                                 out_specs=P("model"))
+    """)
+    assert len(findings) == 1
+    assert "modle" in findings[0].message
+
+
+def test_axis_fires_on_spec_arity_mismatch():
+    findings = fire(AxisConsistencyPass(), """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x, y):
+            return x + y
+
+        def build(mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("data"), P("data"), P()),
+                                 out_specs=P("data"))
+    """)
+    assert len(findings) == 1
+    assert "3 spec(s)" in findings[0].message
+
+
+def test_axis_quiet_on_matching_and_dynamic_names():
+    findings = fire(AxisConsistencyPass(), """
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return lax.psum(x, "data")
+
+        def build(mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("data"),),
+                                 out_specs=P("data"))
+
+        def dyn_body(x, axis_name):
+            return lax.psum(x, axis_name)  # dynamic: never guessed
+    """)
+    assert findings == []
+
+
+# -- trace-purity ------------------------------------------------------------
+
+
+def test_trace_fires_on_clock_rng_and_item():
+    findings = fire(TracePurityPass(), """
+        import time
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def step(params, batch):
+            t0 = time.time()
+            noise = np.random.normal(size=3)
+            loss = (params * batch).sum()
+            return loss.item() + t0 + noise
+    """)
+    kinds = " ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "time.time" in kinds and "np.random" in kinds \
+        and ".item()" in kinds
+
+
+def test_trace_fires_on_branching_on_tracer():
+    findings = fire(TracePurityPass(), """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert len(findings) == 1
+    assert "branching" in findings[0].message
+
+
+def test_trace_quiet_on_static_metadata_and_statics():
+    findings = fire(TracePurityPass(), """
+        import functools
+        import jax
+
+        @jax.jit
+        def shape_static(x):
+            if x.ndim == 3:
+                return x.sum(axis=0)
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("causal",))
+        def masked(x, causal):
+            if causal:
+                return x * 2
+            return x
+
+        def host_side(x):
+            return float(x)  # not a jit boundary: host code may sync
+    """)
+    assert findings == []
+
+
+def test_trace_resolves_duplicate_body_names_per_scope():
+    # two builders each with a local `device_step` (the real pattern in
+    # parallel/train.py): the impurity in the FIRST one must still fire
+    # — a module-wide last-wins name map would silently skip it
+    findings = fire(TracePurityPass(), """
+        import time
+        import jax
+
+        def build_a(mesh):
+            def device_step(x):
+                return x * time.time()  # impure, in builder A's body
+            return jax.shard_map(device_step, mesh=mesh)
+
+        def build_b(mesh):
+            def device_step(x):
+                return x * 2  # clean twin in builder B
+            return jax.shard_map(device_step, mesh=mesh)
+    """)
+    assert len(findings) == 1
+    assert "time.time" in findings[0].message
+
+
+# -- lock-discipline ---------------------------------------------------------
+
+
+def test_lock_fires_on_unlocked_write():
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stage = None  # kf: guarded_by(_lock)
+
+            def put(self, stage):
+                self._stage = stage  # missing lock!
+    """)
+    assert len(findings) == 1
+    assert "_stage" in findings[0].message
+
+
+def test_lock_fires_on_unlocked_container_mutation_and_global():
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        _mu = threading.Lock()
+        _subs = []  # kf: guarded_by(_mu)
+
+        def subscribe(cb):
+            _subs.append(cb)  # missing lock!
+
+        class Pool:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._free = []  # kf: guarded_by(_mu)
+
+            def put(self, x):
+                self._free.append(x)  # missing lock!
+    """)
+    assert len(findings) == 2
+
+
+def test_lock_quiet_on_locked_writes_and_init():
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        _mu = threading.Lock()
+        _active = None  # kf: guarded_by(_mu)
+
+        def install(s):
+            global _active
+            with _mu:
+                _active = s
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stage = None  # kf: guarded_by(_lock)
+
+            def put(self, stage):
+                with self._lock:
+                    self._stage = stage
+    """)
+    assert findings == []
+
+
+def test_lock_fires_on_global_written_from_class_method():
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        _mu = threading.Lock()
+        _subs = []  # kf: guarded_by(_mu)
+
+        class Bus:
+            def subscribe(self, cb):
+                _subs.append(cb)  # missing lock!
+    """)
+    assert len(findings) == 1
+    assert "_subs" in findings[0].message
+
+
+def test_lock_fires_in_closure_defined_under_the_lock():
+    # a callback defined INSIDE `with self._lock:` runs later, on
+    # whatever thread invokes it — the definition-time lock holds
+    # nothing at call time (the ffi trampoline / monitor tick pattern)
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        class Group:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._errors = []  # kf: guarded_by(_mu)
+
+            def register(self, fn):
+                with self._mu:
+                    def cb(e):
+                        self._errors.append(e)  # unlocked at call time
+                    self.cb = cb
+    """)
+    assert len(findings) == 1
+    assert "_errors" in findings[0].message
+
+
+def test_lock_instance_lock_cannot_satisfy_module_guard():
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        _mu = threading.Lock()
+        _active = None  # kf: guarded_by(_mu)
+
+        class Engine:
+            def __init__(self):
+                self._mu = threading.Lock()  # same NAME, different lock
+
+            def disarm(self):
+                global _active
+                with self._mu:
+                    _active = None  # module _mu NOT held!
+    """)
+    assert len(findings) == 1
+    assert "_active" in findings[0].message
+
+
+def test_lock_quiet_on_local_shadowing_a_guarded_global():
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        _mu = threading.Lock()
+        _subs = []  # kf: guarded_by(_mu)
+
+        def local_twin():
+            _subs = []     # binds a LOCAL: not the guarded global
+            _subs.append(1)
+            return _subs
+
+        def real_write():
+            global _subs
+            with _mu:
+                _subs = []
+    """)
+    assert findings == []
+
+
+# -- unused-imports ----------------------------------------------------------
+
+
+def test_unused_imports_fires():
+    findings = fire(UnusedImportsPass(), """
+        import os
+        import sys
+
+        print(sys.argv)
+    """)
+    assert len(findings) == 1
+    assert "'os'" in findings[0].message
+
+
+def test_unused_imports_quiet_on_use_noqa_and_all():
+    findings = fire(UnusedImportsPass(), """
+        import os
+        import compat  # noqa: F401
+        from x import exported
+
+        __all__ = ["exported"]
+        print(os.sep)
+    """)
+    assert findings == []
+
+
+# -- vmem-budget -------------------------------------------------------------
+
+
+def test_vmem_fires_under_tiny_budget():
+    # a 1 MB budget: the real plans cannot fit, so the pass must fire —
+    # this is the "pass demonstrably fires" guard for the model pass
+    findings = vmem_budget.check_flash(budget=1 * 2**20)
+    findings += vmem_budget.check_fused_ce(budget=1 * 2**20)
+    assert findings, "vmem pass silent even under an impossible budget"
+    assert all("VMEM estimate" in f.message for f in findings)
+
+
+def test_vmem_quiet_on_real_budget():
+    assert vmem_budget.check_flash() == []
+    assert vmem_budget.check_fused_ce() == []
+
+
+# -- suppression / plumbing --------------------------------------------------
+
+
+def test_skip_file_marker():
+    findings = fire(RetryDisciplinePass(), """
+        # kflint: skip-file
+        def f():
+            try:
+                g()
+            except:
+                pass
+    """)
+    assert findings == []
+
+
+def test_pass_registry_names_are_unique_and_complete():
+    names = [p.name for p in all_passes()]
+    assert len(names) == len(set(names))
+    assert set(names) >= {"retry-discipline", "axis-consistency",
+                          "trace-purity", "vmem-budget",
+                          "lock-discipline", "unused-imports"}
+
+
+# -- the point: the tree itself lints clean ----------------------------------
+
+
+def test_tree_is_clean():
+    findings = run_paths([PKG])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exits_zero_on_tree():
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.analysis", "kungfu_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    assert "clean" in r.stderr
+
+
+def test_cli_errors_on_missing_path():
+    # a typo'd path must FAIL the gate (exit 2), not green it by
+    # checking zero files
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.analysis", "kungfu_tp/"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 2
+    assert "no such path" in r.stderr
+
+
+def test_cli_exits_nonzero_on_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    try:\n        g()\n    except:\n"
+                   "        pass\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.analysis", str(bad),
+         "--select", "retry-discipline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 1
+    assert "bare except" in r.stdout

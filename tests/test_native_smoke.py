@@ -1,13 +1,19 @@
 """C++ in-proc smoke test: 4-peer cluster driven from native threads.
 
 SURVEY §5.2: the rebuild adds race detection the reference lacked.
-`make test` runs the plain build here; `make -C kungfu_tpu/native
-tsan-test` runs the same scenario under ThreadSanitizer (exercised in
-round-2 development; too slow for every pytest run).
+`make test` runs the plain build here every tier-1 run; the sanitizer
+flavors (ASan+LSan, UBSan, TSan — see docs/static_analysis.md for the
+matrix and suppression policy) run the same scenario instrumented,
+opt-in via the `sanitize` marker (kept with `slow` out of tier-1;
+`scripts/sanitize.sh` loops the full matrix):
+
+    python -m pytest tests/test_native_smoke.py -m sanitize
 """
 
 import os
 import subprocess
+
+import pytest
 
 NATIVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "kungfu_tpu", "native")
@@ -18,3 +24,32 @@ def test_cpp_smoke():
                        capture_output=True, text=True)
     assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:])
     assert "smoke ok" in r.stdout
+
+
+def _run_sanitized(target: str, base_port: int):
+    r = subprocess.run(
+        ["make", "-C", NATIVE, target], timeout=540,
+        capture_output=True, text=True,
+        env={**os.environ, "KF_SMOKE_BASE_PORT": str(base_port)})
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-5000:])
+    assert "smoke ok" in r.stdout
+
+
+@pytest.mark.sanitize
+@pytest.mark.slow
+def test_cpp_smoke_asan():
+    _run_sanitized("asan-test", 27700)
+
+
+@pytest.mark.sanitize
+@pytest.mark.slow
+def test_cpp_smoke_ubsan():
+    _run_sanitized("ubsan-test", 27720)
+
+
+@pytest.mark.sanitize
+@pytest.mark.slow
+def test_cpp_smoke_tsan():
+    # viable in-container since the pthread_cond_clockwait shim
+    # (transport.cpp cv_wait_until_steady); ~40s wall
+    _run_sanitized("tsan-test", 27740)
