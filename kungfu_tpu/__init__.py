@@ -38,6 +38,11 @@ def __getattr__(name):
         attr = getattr(checkpoint, name)
         globals()[name] = attr  # cache: next lookup is a dict hit
         return attr
+    if name == "GradBucketPipeline":
+        from .grad_pipeline import GradBucketPipeline
+
+        globals()[name] = GradBucketPipeline
+        return GradBucketPipeline
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __version__ = "0.1.0"

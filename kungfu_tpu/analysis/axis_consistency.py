@@ -26,6 +26,13 @@ guesses):
   the derivable slice of "PartitionSpec rank matches array rank": the
   rank mismatch Mosaic reports at trace time, the arity mismatch it
   reports as a shape error three layers deep.)
+- **partial-wrapped bodies**: ``shard_map(partial(body, ...), ...)``
+  resolves through the ``functools.partial`` to the wrapped def/lambda
+  (previously these bodies were silently skipped); bound positional/
+  keyword arguments reduce the body's effective arity for the
+  ``in_specs`` check, and a **string literal** bound to the
+  conventional ``axis_name=`` keyword is checked against the declared
+  axes exactly like a literal inside the body.
 """
 
 from __future__ import annotations
@@ -191,12 +198,39 @@ class AxisConsistencyPass:
                 declared |= _spec_axis_names(spec, consts)
 
         body: Optional[ast.AST] = None
+        bound_args = 0       # positional/keyword params partial binds
+        partial_kws: List[ast.keyword] = []
         if call.args:
             first = call.args[0]
             if isinstance(first, ast.Lambda):
                 body = first
             elif isinstance(first, ast.Name):
                 body = defs.get(first.id)
+            elif (isinstance(first, ast.Call)
+                  and _tail(call_name(first)) == "partial"
+                  and first.args):
+                inner = first.args[0]
+                if isinstance(inner, ast.Lambda):
+                    body = inner
+                elif isinstance(inner, ast.Name):
+                    body = defs.get(inner.id)
+                partial_kws = first.keywords
+                if any(k.arg is None for k in partial_kws):
+                    body = None  # **kwargs splat: arity underivable
+                elif body is not None:
+                    # keyword binds consume a POSITIONAL slot only when
+                    # they name a positional param (binding a
+                    # keyword-only param must not shrink the arity)
+                    positional = set()
+                    if isinstance(body, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)):
+                        positional = {a.arg for a in
+                                      (body.args.posonlyargs
+                                       + body.args.args)}
+                    bound_args = (len(first.args) - 1
+                                  + sum(1 for k in partial_kws
+                                        if k.arg in positional))
 
         # 1) literal axis names used by collectives in the body
         if body is not None and declared:
@@ -224,15 +258,36 @@ class AxisConsistencyPass:
                     if f:
                         findings.append(f)
 
-        # 2) arity: in_specs tuple vs body positional params
+        # 1b) a string literal bound to the conventional axis_name=
+        # keyword of a partial-wrapped body is an axis name too
+        if declared:
+            for kw in partial_kws:
+                if kw.arg != "axis_name":
+                    continue
+                axis = _resolve_axis(kw.value, consts)
+                if axis is not None and axis not in declared:
+                    f = src.finding(
+                        kw.value, NAME,
+                        f"partial(..., axis_name={axis!r}) wrapping a "
+                        f"shard_map body names an axis not declared by "
+                        f"the call site (declared: {sorted(declared)})")
+                    if f:
+                        findings.append(f)
+
+        # 2) arity: in_specs tuple vs body positional params (minus
+        # whatever a wrapping partial already bound)
         if isinstance(in_specs, ast.Tuple) and body is not None:
             arity = _positional_arity(body)
-            if arity is not None and arity != len(in_specs.elts):
+            if arity is not None:
+                arity -= bound_args
+            if (arity is not None and arity >= 0
+                    and arity != len(in_specs.elts)):
                 f = src.finding(
                     call, NAME,
                     f"in_specs declares {len(in_specs.elts)} spec(s) but "
                     f"the shard_map body takes {arity} positional "
-                    "argument(s)")
+                    "argument(s)"
+                    + (" after partial binding" if bound_args else ""))
                 if f:
                     findings.append(f)
 

@@ -105,6 +105,187 @@ def worker_main(model: str, epochs: int, warmup: int, fuse: bool,
     p.stop()
 
 
+def grad_worker_main(model: str, steps: int, warmup: int, pipeline: str,
+                     compress: str, backward_ms: float,
+                     bucket_mb: float) -> None:
+    """One worker of the gradient-pipeline benchmark.
+
+    Simulates a backward pass that produces gradient leaves in REVERSE
+    leaf order over `backward_ms` (each leaf's callable blocks until
+    its production time — exactly how JAX async dispatch gates
+    `np.asarray(leaf)`), then measures what the lump vs the bucketed
+    pipeline EXPOSES after backward ends:
+
+    - ``lump``: wait for the full backward, then one single-bucket
+      pipeline pass (exposed comm = the whole transfer).
+    - ``bucketed``: hand the producer callables straight to
+      `GradBucketPipeline` — output-side buckets hit the wire while
+      the input-side "backward" still runs; exposed comm is only the
+      tail that outlives the last-produced gradient.
+    """
+    import numpy as np
+
+    import kungfu_tpu
+    from kungfu_tpu.grad_pipeline import GradBucketPipeline
+    from kungfu_tpu.models.fake_models import fake_model_catalog
+
+    p = kungfu_tpu.init()
+    counts = fake_model_catalog(model)
+    rng = np.random.default_rng(p.rank)
+    grads = {name: rng.standard_normal(n).astype(np.float32)
+             for name, n in counts.items()}
+    total_bytes = sum(g.nbytes for g in grads.values())
+    bucket_bytes = (int(bucket_mb * 2**20) if pipeline == "bucketed"
+                    else 2**62)  # lump: one bucket per dtype run
+    pipe = GradBucketPipeline(p, grads, bucket_bytes=bucket_bytes,
+                              compression=compress,
+                              name=f"gp:{pipeline}:{compress}")
+
+    # production times: reverse leaf order, proportional share of the
+    # backward window by element count (big early layers take longer)
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    n_leaves = len(leaves)
+    ready_frac = [0.0] * n_leaves
+    acc = 0
+    total_elems = sum(l.size for l in leaves)
+    for i in reversed(range(n_leaves)):
+        acc += leaves[i].size
+        ready_frac[i] = acc / max(1, total_elems)
+
+    def producer_tree(t0):
+        def make(i, leaf):
+            def produce():
+                ready = t0 + backward_ms / 1e3 * ready_frac[i]
+                while True:
+                    dt = ready - time.perf_counter()
+                    if dt <= 0:
+                        return leaf
+                    time.sleep(min(dt, 0.005))
+
+            return produce
+
+        # dict pytrees flatten in sorted-key order: index by that order
+        # so callable i gates on leaves[i]'s production time
+        return {name: make(i, grads[name])
+                for i, name in enumerate(sorted(grads))}
+
+    exposed, step_ms, egress = [], [], []
+    p.barrier()
+    for it in range(warmup + steps):
+        eg0 = p.stats()["egress_bytes"]
+        t0 = time.perf_counter()
+        if pipeline == "lump":
+            time.sleep(backward_ms / 1e3)  # the whole backward first
+            pipe.all_reduce(grads)
+        else:
+            pipe.all_reduce(producer_tree(t0))
+        t1 = time.perf_counter()
+        p.barrier()
+        if it >= warmup:
+            exposed.append((t1 - t0) * 1e3 - backward_ms)
+            step_ms.append((t1 - t0) * 1e3)
+            egress.append(p.stats()["egress_bytes"] - eg0)
+
+    if p.rank == 0:
+        out = {
+            "np": p.size,
+            "model": model,
+            "pipeline": pipeline,
+            "compress": compress,
+            "buckets": pipe.num_buckets,
+            "backward_ms": backward_ms,
+            "model_mb": round(total_bytes / 2**20, 1),
+            "payload_mb_per_step": round(
+                pipe.last_step_info["payload_bytes"] / 2**20, 2),
+            "egress_mb_per_step": round(
+                sum(egress) / len(egress) / 2**20, 2),
+            "exposed_comm_ms": round(
+                sorted(exposed)[len(exposed) // 2], 1),
+            "step_ms": round(sorted(step_ms)[len(step_ms) // 2], 1),
+        }
+        path = os.environ.get("KF_BENCH_OUT")
+        if path:
+            with open(path, "w") as f:
+                json.dump(out, f)
+        else:
+            print(json.dumps(out), flush=True)
+    pipe.close()
+    p.stop()
+
+
+def run_grad_one(np_: int, model: str, steps: int, warmup: int,
+                 pipeline: str, compress: str, backward_ms: float,
+                 bucket_mb: float, port_range: str,
+                 timeout: float = 600.0) -> dict:
+    """Launch one kfrun gradient-pipeline job; rank 0's row."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    with tempfile.TemporaryDirectory(prefix="kf-gpbench-") as td:
+        out_path = os.path.join(td, "rank0.json")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["KF_BENCH_OUT"] = out_path
+        env.setdefault("KF_LOG_LEVEL", "warn")
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, "-m", "kungfu_tpu.run",
+               "-np", str(np_), "-port-range", port_range,
+               "-logdir", os.path.join(td, "logs"), "-q", "--",
+               sys.executable, "-m", "kungfu_tpu.benchmarks.allreduce",
+               "--grad-worker", "--model", model,
+               "--steps", str(steps), "--warmup", str(warmup),
+               "--pipeline", pipeline, "--compress", compress,
+               "--backward-ms", str(backward_ms),
+               "--bucket-mb", str(bucket_mb)]
+        r = subprocess.run(cmd, env=env, cwd=repo, timeout=timeout,
+                           capture_output=True, text=True)
+        if r.returncode != 0 or not os.path.exists(out_path):
+            raise RuntimeError(
+                f"grad np={np_} {pipeline}/{compress} failed "
+                f"rc={r.returncode}:"
+                f"\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+        with open(out_path) as f:
+            return json.load(f)
+
+
+def grad_matrix_main(args) -> None:
+    """Driver: {lump, bucketed} x {fp32, bf16, int8-EF} over --np."""
+    rows = []
+    for np_ in [int(s) for s in args.np.split(",")]:
+        for pipeline in ("lump", "bucketed"):
+            for compress in ("none", "bf16", "int8"):
+                rows.append(run_grad_one(
+                    np_, args.model, args.steps, args.warmup, pipeline,
+                    compress, args.backward_ms, args.bucket_mb,
+                    args.port_range))
+                print(json.dumps(rows[-1]), flush=True)
+    by_key = {(r["np"], r["pipeline"], r["compress"]): r for r in rows}
+    summary = []
+    for np_ in sorted({r["np"] for r in rows}):
+        lump = by_key[(np_, "lump", "none")]
+        for pipeline in ("lump", "bucketed"):
+            for compress in ("none", "bf16", "int8"):
+                r = by_key[(np_, pipeline, compress)]
+                summary.append({
+                    "np": np_, "pipeline": pipeline,
+                    "compress": compress,
+                    "exposed_comm_ms": r["exposed_comm_ms"],
+                    "step_ms": r["step_ms"],
+                    "payload_mb": r["payload_mb_per_step"],
+                    "exposed_vs_lump_fp32": round(
+                        r["exposed_comm_ms"]
+                        / max(1e-9, lump["exposed_comm_ms"]), 3),
+                })
+    print(json.dumps({
+        "metric": "dcn_grad_pipeline",
+        "model": args.model,
+        "backward_ms": args.backward_ms,
+        "bucket_mb": args.bucket_mb,
+        "rows": summary,
+    }))
+
+
 def run_one(np_: int, strategy: str, model: str, epochs: int,
             warmup: int, fuse: bool, port_range: str,
             timeout: float = 300.0, mode: str = "seq") -> dict:
@@ -155,7 +336,29 @@ def main():
                     help="comma-separated worker counts (driver mode)")
     ap.add_argument("--strategies", default="RING,BINARY_TREE_STAR,AUTO")
     ap.add_argument("--port-range", default="11000-12500")
+    # gradient-pipeline benchmark (docs/grad_pipeline.md):
+    # {lump, bucketed} x {none, bf16, int8} with a simulated backward
+    ap.add_argument("--grad-pipeline", action="store_true",
+                    help="driver: run the bucketed/compressed gradient "
+                         "matrix instead of the plain all-reduce sweep")
+    ap.add_argument("--grad-worker", action="store_true")
+    ap.add_argument("--pipeline", default="bucketed",
+                    choices=("lump", "bucketed"))
+    ap.add_argument("--compress", default="none",
+                    choices=("none", "bf16", "int8"))
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--backward-ms", type=float, default=150.0,
+                    help="simulated backward-pass duration per step")
+    ap.add_argument("--bucket-mb", type=float, default=1.0)
     args = ap.parse_args()
+    if args.grad_worker:
+        grad_worker_main(args.model, args.steps, args.warmup,
+                         args.pipeline, args.compress, args.backward_ms,
+                         args.bucket_mb)
+        return
+    if args.grad_pipeline:
+        grad_matrix_main(args)
+        return
     if args.worker:
         worker_main(args.model, args.epochs, args.warmup, args.fuse,
                     args.mode)

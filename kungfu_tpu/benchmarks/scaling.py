@@ -14,6 +14,13 @@ numbers are not hardware claims — the line is labeled accordingly).
 Run:  python -m kungfu_tpu.benchmarks.scaling [--model resnet50]
           [--sizes 1,2,4,8] [--batch 32] [--iters 10]
 
+`--dcn-grad` switches to the CROSS-HOST axis: np kfrun worker
+processes run the per-step gradient exchange (simulated backward +
+real libkf DCN collectives) and the efficiency denominator is the
+comm-free backward time — 1.0 means the gradient pipeline hid every
+wire byte behind backward. Rows cover {lump, bucketed-overlap} x
+{fp32, bf16, int8-EF} per size (docs/grad_pipeline.md).
+
 Prints one JSON line with per-size throughput and efficiencies.
 """
 
@@ -25,6 +32,46 @@ import json
 from .throughput import MODELS, measure_rate
 
 
+def dcn_grad_main(args) -> int:
+    """DCN gradient-step scaling: efficiency = backward / step wall."""
+    from .allreduce import run_grad_one
+
+    sizes = [int(s) for s in (args.sizes or "2,4,8").split(",")]
+    rows = []
+    for np_ in sizes:
+        for pipeline in ("lump", "bucketed"):
+            for compress in ("none", "bf16", "int8"):
+                r = run_grad_one(np_, args.dcn_model, args.iters,
+                                 args.warmup, pipeline, compress,
+                                 args.backward_ms, args.bucket_mb,
+                                 args.port_range)
+                r["scaling_efficiency"] = round(
+                    args.backward_ms / max(1e-9, r["step_ms"]), 3)
+                rows.append(r)
+                print(json.dumps(r), flush=True)
+    out = {
+        "metric": "dcn_grad_scaling_efficiency",
+        "model": rows[0]["model"],
+        "backward_ms": args.backward_ms,
+        "bucket_mb": args.bucket_mb,
+        "note": "efficiency = simulated-backward ms / measured step "
+                "ms; 1.0 = all DCN comm hidden behind backward "
+                "(loopback fabric, not a hardware claim)",
+        "efficiency": {
+            f"np{r['np']}:{r['pipeline']}:{r['compress']}":
+                r["scaling_efficiency"]
+            for r in rows
+        },
+        "exposed_comm_ms": {
+            f"np{r['np']}:{r['pipeline']}:{r['compress']}":
+                r["exposed_comm_ms"]
+            for r in rows
+        },
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=sorted(MODELS), default="resnet50")
@@ -33,7 +80,18 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=32, help="per-chip batch")
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--dcn-grad", action="store_true",
+                    help="measure DCN gradient-pipeline scaling over "
+                         "kfrun workers instead of ICI throughput")
+    ap.add_argument("--dcn-model", default="resnet50-imagenet",
+                    help="fake-model catalog for --dcn-grad")
+    ap.add_argument("--backward-ms", type=float, default=150.0)
+    ap.add_argument("--bucket-mb", type=float, default=1.0)
+    ap.add_argument("--port-range", default="14000-15500")
     args = ap.parse_args(argv)
+
+    if args.dcn_grad:
+        return dcn_grad_main(args)
 
     import jax
 

@@ -44,6 +44,7 @@ from kungfu_tpu.data import ElasticSampler
 from kungfu_tpu.datasets import load_synthetic_split
 from kungfu_tpu.elastic import ElasticCallback
 from kungfu_tpu.ffi import KfError
+from kungfu_tpu.grad_pipeline import GradBucketPipeline, grad_bucket_bytes
 from kungfu_tpu.initializer import broadcast_variables
 from kungfu_tpu.models import SLP
 from kungfu_tpu.ops.collective import defuse, fuse
@@ -77,6 +78,19 @@ def loss_and_grads(params, batch):
 
 elastic = ElasticCallback(peer, schedule=SCHEDULE,
                           samples_per_step=BATCH)
+
+# KF_GRAD_BUCKET_MB > 0 switches the gradient all-reduce from the
+# monolithic lump to the bucketed, overlapped pipeline (compression
+# from KF_GRAD_COMPRESS). Its error-feedback residuals are PER-RANK
+# state living in the pipe object: survivors keep theirs across every
+# epoch switch below (the pipe outlives resizes — the model shape
+# never changes, only the peer set), joiners start at zero, and
+# durable checkpoints carry them via pipe.state() next to opt_state.
+GRAD_BUCKET_BYTES = grad_bucket_bytes(
+    None if os.environ.get("KF_GRAD_BUCKET_MB") else 0)
+pipe = (GradBucketPipeline(peer, params,
+                           bucket_bytes=GRAD_BUCKET_BYTES)
+        if GRAD_BUCKET_BYTES > 0 else None)
 
 
 def make_sampler():
@@ -142,8 +156,14 @@ while elastic.state.step < TOTAL_STEPS:
     loss, grads = loss_and_grads(params, batch)
     loss = float(loss)
     try:
-        buf = peer.all_reduce(np.asarray(fuse(grads)),
-                              name=f"g:{peer.version}:{elastic.state.step}")
+        if pipe is not None:
+            # the agreed step tags the wire names: a replacement
+            # joiner's fresh pipe must align with survivors' pipes
+            grads = pipe.all_reduce(grads, step=elastic.state.step)
+        else:
+            buf = peer.all_reduce(
+                np.asarray(fuse(grads)),
+                name=f"g:{peer.version}:{elastic.state.step}")
     except KfError:
         if not RECOVER:
             raise
@@ -155,7 +175,8 @@ while elastic.state.step < TOTAL_STEPS:
         print(f"KF_MTTR resumed t={time.time() * 1e3:.1f} "
               f"rank={peer.rank} step={elastic.state.step}", flush=True)
         just_recovered = False
-    grads = defuse(jnp.asarray(buf) / peer.size, grads)
+    if pipe is None:
+        grads = defuse(jnp.asarray(buf) / peer.size, grads)
     updates, opt_state = tx.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
 

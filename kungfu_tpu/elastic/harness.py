@@ -156,7 +156,8 @@ def run_survivor_recovery(crash_rank: int = 1,
                           slots: int = 4,
                           port_range: str = "27100-27999",
                           timeout: int = 600,
-                          logdir: str | None = None) -> str:
+                          logdir: str | None = None,
+                          extra_env: dict | None = None) -> str:
     """Kill one worker mid-training via a chaos schedule and assert the
     survivors shrink membership, restore state, and finish the run with
     loss continuity — no operator action. The full recovery pipeline is
@@ -195,6 +196,9 @@ def run_survivor_recovery(crash_rank: int = 1,
             # fast failure detection: survivors' blocked receives fail
             # on conn EOF (no timeout wait), but keep a short ceiling
             "KF_RECOVERY_DEADLINE_MS": "30000",
+            # callers layer e.g. the bucketed/compressed gradient
+            # pipeline (KF_GRAD_BUCKET_MB/KF_GRAD_COMPRESS) on top
+            **(extra_env or {}),
         },
         extra_flags=["-recover"],
     )

@@ -188,7 +188,15 @@ class ElasticCallback:
         Records the phase decomposition into `last_resize_timings`
         (merged with the peer's fetch/consensus/adopt-barrier phases):
         `pack_ms` / `broadcast_ms` / `position_ms` as before, plus
-        `overlap_ms` and `stream_chunks` on the streaming path."""
+        `overlap_ms` and `stream_chunks` on the streaming path.
+
+        `params` may be any pytree — e.g. ``(params, opt_state)`` or,
+        for restore-your-own-state flows, a tree that includes a
+        `GradBucketPipeline.state()` residual dict (numpy leaves
+        stream byte-exactly). Live-rank resyncs should NOT broadcast
+        EF residuals between ranks: they are per-rank state
+        (docs/grad_pipeline.md, "Residuals and the elastic
+        runtime")."""
         from .streaming import stream_broadcast, stream_chunk_bytes
 
         t0 = time.perf_counter()

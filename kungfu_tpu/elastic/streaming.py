@@ -38,7 +38,6 @@ so all dtypes (ints, bools, bf16) survive bit-for-bit
 
 from __future__ import annotations
 
-import os
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -46,6 +45,7 @@ from typing import Tuple
 
 import numpy as np
 
+from ..env import env_float
 from ..ops.collective import chunk_schedule, leaf_byte_views
 
 #: default streaming chunk size (MiB). Small enough that the tail
@@ -58,11 +58,12 @@ DEFAULT_CHUNK_MB = 4
 
 def stream_chunk_bytes(chunk_mb: float | None = None) -> int:
     """Resolve the streaming chunk size in bytes: explicit argument,
-    else KF_STREAM_CHUNK_MB, else `DEFAULT_CHUNK_MB`. Returns 0 when
-    streaming is disabled (chunk size 0 or negative)."""
+    else KF_STREAM_CHUNK_MB (validated at parse time — a typo'd value
+    raises instead of silently misconfiguring the resync data path),
+    else `DEFAULT_CHUNK_MB`. Returns 0 when streaming is disabled
+    (chunk size 0 or negative)."""
     if chunk_mb is None:
-        chunk_mb = float(os.environ.get("KF_STREAM_CHUNK_MB",
-                                        DEFAULT_CHUNK_MB))
+        chunk_mb = env_float("KF_STREAM_CHUNK_MB", DEFAULT_CHUNK_MB)
     if chunk_mb <= 0:
         return 0
     return max(1, int(chunk_mb * 2**20))

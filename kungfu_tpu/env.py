@@ -9,6 +9,7 @@ itself, so every program using kungfu_tpu also runs standalone.
 
 from __future__ import annotations
 
+import math
 import os
 from dataclasses import dataclass, field
 from typing import Dict, Optional
@@ -39,6 +40,11 @@ CONFIG_VARS = (
     # deterministic fault schedules (kungfu_tpu/chaos.py)
     "KF_CHAOS",
     "KF_CHAOS_FILE",
+    # data-path tuning: elastic resync streaming + the bucketed,
+    # compressed gradient pipeline (docs/grad_pipeline.md)
+    "KF_STREAM_CHUNK_MB",
+    "KF_GRAD_BUCKET_MB",
+    "KF_GRAD_COMPRESS",
 )
 
 ALL_BOOTSTRAP_VARS = (
@@ -50,6 +56,45 @@ ALL_BOOTSTRAP_VARS = (
     ALLREDUCE_STRATEGY,
     CONFIG_SERVER,
 )
+
+
+def env_float(name: str, default: float,
+              environ: Optional[Dict[str, str]] = None,
+              minimum: Optional[float] = None) -> float:
+    """Parse a numeric KF_* tuning variable, failing LOUDLY at parse
+    time on garbage instead of letting a typo silently misconfigure the
+    data path (``KF_STREAM_CHUNK_MB=4MB`` must be an error, not a
+    fallen-through default). Unset or empty -> `default`. `minimum`,
+    when given, is inclusive; NaN is always rejected."""
+    e = os.environ if environ is None else environ
+    raw = e.get(name, "")
+    if raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a number; unset it for the default "
+            f"({default})") from None
+    if math.isnan(v):
+        raise ValueError(f"{name}={raw!r} is NaN")
+    if minimum is not None and v < minimum:
+        raise ValueError(f"{name}={raw!r} must be >= {minimum}")
+    return v
+
+
+def env_choice(name: str, default: str, choices,
+               environ: Optional[Dict[str, str]] = None) -> str:
+    """Parse an enum-valued KF_* variable with a clear error naming the
+    valid values. Unset or empty -> `default`."""
+    e = os.environ if environ is None else environ
+    raw = e.get(name, "")
+    if raw == "":
+        return default
+    if raw not in choices:
+        raise ValueError(
+            f"{name}={raw!r} is not one of {sorted(choices)}")
+    return raw
 
 
 @dataclass

@@ -74,7 +74,11 @@ try:
 except ImportError:  # pragma: no cover - ml_dtypes ships with jax
     pass
 
-_OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
+# sum_sat: integer dtypes clamp at the dtype bounds instead of wrapping —
+# the accumulate the int8 compressed-gradient wire uses (clipping error
+# is absorbed by error feedback; wraparound would flip gradient signs).
+# Float dtypes: identical to sum.
+_OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3, "sum_sat": 4}
 
 CONTROL_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64
@@ -321,9 +325,14 @@ class OrderGroup:
             del self._cbs[:len(self._names)]
             errors, self._errors = self._errors, []
         if errors:
-            raise RuntimeError(
+            err = RuntimeError(
                 "order-group task(s) failed: "
                 + "; ".join(f"{n}: {e}" for n, e in errors))
+            # the original exception objects, for callers that must
+            # type-dispatch (the gradient pipeline re-raises a KfError
+            # so survivor recovery sees a peer death as itself)
+            err.task_errors = errors
+            raise err
         return [self._names[i] for i in out]
 
     def close(self):
@@ -433,6 +442,31 @@ class NativePeer:
             f"all_reduce {name}",
         )
         return out
+
+    def all_reduce_inplace(self, x: np.ndarray, op: str = "sum",
+                           name: str = "") -> np.ndarray:
+        """All-reduce `x` INTO `x` — zero copies on any rank.
+
+        Passes the same buffer as send and recv: `Session::all_reduce`
+        skips its entry memcpy when the pointers alias, accumulates
+        received chunks straight into `x`, and the broadcast-phase
+        receive lands in place. This is the bucketed gradient-pipeline
+        entry point — the allocating `all_reduce` above pays an
+        `np.empty_like` landing buffer per call, which per-bucket would
+        re-grow a model-sized copy per step. Returns `x`.
+        """
+        if not x.flags["C_CONTIGUOUS"]:
+            raise ValueError("all_reduce_inplace needs a C-contiguous "
+                             "buffer")
+        if not x.flags.writeable:
+            raise ValueError("all_reduce_inplace needs a writeable buffer")
+        _check(
+            self._lib.kf_all_reduce(self._h, _buf_ptr(x), _buf_ptr(x),
+                                    x.size, dtype_code(x.dtype), op_code(op),
+                                    name.encode() or b"allreduce"),
+            f"all_reduce_inplace {name}",
+        )
+        return x
 
     def reduce(self, x: np.ndarray, op: str = "sum", root: int = 0,
                name: str = "") -> Optional[np.ndarray]:

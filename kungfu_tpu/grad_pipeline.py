@@ -1,0 +1,445 @@
+"""Backward-overlapped, bucketed, compressed DCN gradient all-reduce.
+
+The lump gradient path (`peer.all_reduce(fuse(grads))`) serializes the
+whole post-backward step: every gradient byte waits for the slowest
+layer's backward, then the full model crosses DCN as one synchronous
+transfer. This module generalizes the elastic-resync chunk pipeline
+(PR 3, `elastic/streaming.py`) to the per-step gradient path, applying
+two proven ideas from related work:
+
+- **Reverse-backward bucketing with comm/compute overlap** (PyTorch
+  DDP, Li et al. 2020; Horovod tensor fusion): gradients are assigned
+  to fixed-byte buckets in REVERSE leaf order — the order backward
+  produces them — by `ops.collective.bucket_schedule`, and each
+  bucket's all-reduce launches as soon as its last gradient
+  materializes on host, while earlier layers' backward still runs
+  (JAX async dispatch: `np.asarray(leaf)` blocks only until *that
+  leaf* is computed, so output-side buckets hit the wire first).
+- **Error-feedback gradient compression** (EF-SGD, Karimireddy et al.
+  2019): per-bucket bf16 (2x fewer wire bytes) or int8 (4x) variants
+  keep a local f32 residual of what compression dropped and re-inject
+  it into the next step's bucket, so the quantization error is
+  compensated instead of accumulated. Residual state lives in this
+  object and is exposed as a pytree (`state()`/`load_state()`) so it
+  sits NEXT TO optimizer state in checkpoints and elastic resync — a
+  joiner adopting survivor state adopts the residuals too.
+
+Determinism across peers: bucket contents and order are derived from
+shapes/dtypes only (every rank computes the identical schedule), and
+the retained `OrderGroup` engine (`ffi.kf_order_group_*` — the
+reference's gradient-ordering negotiation primitive) executes the wire
+ops in schedule order regardless of the order packer threads deliver
+them, so named collectives hit the wire identically on every rank.
+The recorded arrival order (`last_step_info["arrival"]`) is the signal
+an adaptive scheduler would broadcast to re-negotiate the schedule.
+
+Wire formats (decompress+accumulate runs in libkf's SIMD reduce
+kernels, so the wire carries compressed bytes END TO END — no hop ever
+re-inflates to f32):
+
+- ``none``: dtype-native spans of the host gradient leaves, summed in
+  place (`all_reduce_inplace`, send==recv aliasing — no landing copy).
+  Bit-identical to the lump path.
+- ``bf16``: f32 bucket + residual narrowed to bf16; summed by the
+  native bf16 kernels (widen to f32, add, narrow RNE per hop).
+- ``int8``: a 4-byte per-bucket scale negotiation (`max` all-reduce of
+  the local amax) precedes the payload so every peer quantizes against
+  the SAME scale, each into the ±(127 // np) budget so the summed
+  payload fits int8 (QSGD-style range split; the traded precision is
+  absorbed by the residual); the payload is summed with the saturating
+  `sum_sat` kernel, so even pathological clipping degrades gracefully
+  instead of wrapping into sign-flipped gradients.
+
+See docs/grad_pipeline.md for the full protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .env import env_choice, env_float
+from .ffi import KfError, OrderGroup
+from .ops.collective import bucket_schedule
+
+#: default bucket size (MiB). The native layer re-chunks to 1 MiB for
+#: the wire, so larger buckets only delay the first launch; 1 MiB
+#: matched the elastic-streaming sweep optimum on the loopback fabric.
+DEFAULT_BUCKET_MB = 1.0
+
+COMPRESSIONS = ("none", "bf16", "int8")
+
+
+def grad_bucket_bytes(bucket_mb: Optional[float] = None) -> int:
+    """Resolve the bucket size in bytes: explicit argument, else
+    KF_GRAD_BUCKET_MB (validated at parse time), else
+    `DEFAULT_BUCKET_MB`. Returns 0 when bucketing is disabled (size 0
+    or negative) — callers fall back to the lump path."""
+    if bucket_mb is None:
+        bucket_mb = env_float("KF_GRAD_BUCKET_MB", DEFAULT_BUCKET_MB)
+    if bucket_mb <= 0:
+        return 0
+    return max(1, int(bucket_mb * 2**20))
+
+
+def grad_compression(compression: Optional[str] = None) -> str:
+    """Resolve the compression mode: explicit argument, else
+    KF_GRAD_COMPRESS (validated against the known modes)."""
+    if compression is None:
+        return env_choice("KF_GRAD_COMPRESS", "none", COMPRESSIONS)
+    if compression not in COMPRESSIONS:
+        raise ValueError(
+            f"compression {compression!r} is not one of {COMPRESSIONS}")
+    return compression
+
+
+def _bf16_dtype():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+class GradBucketPipeline:
+    """Bucketed, overlapped, optionally compressed gradient all-reduce.
+
+    Built once per (model, peer) from a gradient *template* (any pytree
+    with the gradients' structure/shapes/dtypes — e.g. the params) and
+    reused every step::
+
+        pipe = GradBucketPipeline(peer, params, compression="int8")
+        ...
+        loss, grads = loss_and_grads(params, batch)   # jitted, async
+        grads = pipe.all_reduce(grads)                # mean over peers
+
+    `all_reduce` accepts leaves as jax arrays (fetched with
+    `np.asarray`, which blocks per-leaf — the overlap mechanism),
+    numpy arrays, or zero-argument callables returning numpy (the
+    benchmark's simulated-backward producer). Compression modes
+    require float32 gradients; ``none`` carries any control-plane
+    dtype.
+    """
+
+    def __init__(self, peer, grads_template, bucket_bytes: Optional[int]
+                 = None, compression: Optional[str] = None,
+                 name: str = "kf::grad", packers: int = 2):
+        import jax
+
+        self.peer = peer
+        self.name = name
+        self.compression = grad_compression(compression)
+        if bucket_bytes is None:
+            bucket_bytes = grad_bucket_bytes()
+        if bucket_bytes <= 0:
+            raise ValueError("GradBucketPipeline needs bucket_bytes > 0; "
+                             "use the lump path when bucketing is "
+                             "disabled")
+        self.bucket_bytes = int(bucket_bytes)
+        leaves, self._treedef = jax.tree_util.tree_flatten(grads_template)
+        self._shapes = [np.shape(l) for l in leaves]
+        self._dtypes = []
+        for l in leaves:
+            dt = getattr(l, "dtype", None)
+            self._dtypes.append(np.dtype(dt) if dt is not None
+                                else np.asarray(l).dtype)
+        self._schedule = bucket_schedule(grads_template, self.bucket_bytes)
+        if self.compression != "none":
+            bad = sorted({str(dt) for dt, _ in self._schedule
+                          if dt != np.dtype(np.float32)})
+            if bad:
+                raise ValueError(
+                    f"{self.compression} compression needs float32 "
+                    f"gradients; template has {bad} leaves")
+        self._names = [f"b{k}" for k in range(len(self._schedule))]
+        self._group = OrderGroup(self._names) if self._names else None
+        # EF residuals: one f32 buffer per bucket, persistent across
+        # steps (and across elastic epochs — the model doesn't change
+        # shape on a resize, only the peer set does)
+        self._residual: List[np.ndarray] = [
+            np.zeros(sum(n for _, _, n in spans), np.float32)
+            for _, spans in self._schedule
+        ] if self.compression != "none" else []
+        self._packers = max(1, packers)
+        # long-lived: per-step thread churn has no place on the hot
+        # path this module exists to optimize
+        self._pool = ThreadPoolExecutor(max_workers=self._packers,
+                                        thread_name_prefix="kf-grad-pack")
+        self._round = 0
+        #: diagnostics of the most recent step: wire payload bytes,
+        #: per-phase times, and the true bucket arrival order (the
+        #: re-negotiation signal)
+        self.last_step_info: Dict = {}
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self._schedule)
+
+    def close(self):
+        if self._group is not None:
+            self._group.close()
+            self._group = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    # -- EF residual state (lives next to optimizer state) -------------------
+
+    def state(self) -> Dict:
+        """The error-feedback residual state as a plain pytree.
+
+        Include this next to the optimizer state in everything that
+        moves training state: checkpoints and the elastic resync
+        broadcast (`resync_params((params, opt_state, pipe.state()))`)
+        — a joiner that adopts survivor params without the survivors'
+        residuals would silently diverge from the compensation the
+        compressed stream already promised. Empty for ``none``."""
+        return {"compression": self.compression,
+                "residual": [r.copy() for r in self._residual]}
+
+    def load_state(self, state: Dict):
+        """Adopt residual state produced by `state()` (possibly carried
+        through a resync broadcast or checkpoint restore)."""
+        if state.get("compression") != self.compression:
+            raise ValueError(
+                f"residual state is for compression="
+                f"{state.get('compression')!r}, pipeline runs "
+                f"{self.compression!r}")
+        res = state.get("residual", [])
+        if len(res) != len(self._residual):
+            raise ValueError(
+                f"residual state has {len(res)} buckets, schedule has "
+                f"{len(self._residual)}")
+        for mine, theirs in zip(self._residual, res):
+            arr = np.asarray(theirs, dtype=np.float32).reshape(-1)
+            if arr.size != mine.size:
+                raise ValueError("residual bucket size mismatch")
+            mine[:] = arr
+
+    # -- per-step all-reduce --------------------------------------------------
+
+    def all_reduce(self, grads, average: bool = True,
+                   step: Optional[int] = None):
+        """Mean (or sum) `grads` over the cluster, bucket-pipelined.
+
+        Wire names are tagged ``{name}:{epoch}:{step}:bK``. ELASTIC
+        callers must pass the cluster-agreed `step` (e.g.
+        ``elastic.state.step``): a joiner's fresh pipeline and the
+        survivors' long-lived ones must produce identical names or the
+        name-keyed rendezvous deadlocks. Static clusters may omit it
+        (an internal counter advances identically on every rank).
+
+        Returns a pytree with the template's structure; leaves are host
+        numpy arrays (control-plane discipline: the result re-enters
+        the jitted update step, which devices it once). Writeable
+        contiguous numpy input leaves are CONSUMED — the reduction
+        lands in their buffers (the zero-copy contract); jax leaves
+        pay their one device->host copy and are never mutated."""
+        import jax
+
+        leaves = jax.tree_util.tree_flatten(grads)[0]
+        if len(leaves) != len(self._shapes):
+            raise ValueError(
+                f"grads tree has {len(leaves)} leaves, template has "
+                f"{len(self._shapes)}")
+        t0 = time.perf_counter()
+        if step is None:
+            step = self._round
+            self._round += 1
+        tag = f"{self.name}:{self.peer.version}:{step}"
+        size = max(1, self.peer.size)
+
+        # per-leaf flat host buffers, fetched at most once per step.
+        # np.asarray on a jax leaf blocks until THAT leaf's backward is
+        # done — fetching in schedule (reverse-backward) order is what
+        # lets bucket 0 hit the wire while earlier layers still compute.
+        flats: List[Optional[np.ndarray]] = [None] * len(leaves)
+        fetch_mu = threading.Lock()
+
+        def fetch(i: int) -> np.ndarray:
+            with fetch_mu:
+                if flats[i] is None:
+                    l = leaves[i]
+                    if callable(l):
+                        l = l()
+                    a = np.asarray(l)
+                    if a.dtype != self._dtypes[i]:
+                        raise ValueError(
+                            f"leaf {i} dtype {a.dtype} != template "
+                            f"{self._dtypes[i]}")
+                    # the wire accumulates into this buffer, so it must
+                    # be contiguous + writeable; jax leaves surface as
+                    # read-only views and pay their one host copy here
+                    if not (isinstance(a, np.ndarray)
+                            and a.flags.c_contiguous
+                            and a.flags.writeable):
+                        buf = np.ascontiguousarray(a)
+                        if not buf.flags.writeable or buf is a:
+                            buf = buf.copy()
+                        a = buf
+                    flats[i] = a.reshape(-1)
+                return flats[i]
+
+        errors: List = []
+        err_mu = threading.Lock()
+        wire_bytes = [0]
+        t_wire = [0.0]
+
+        def wire_clock(fn):
+            t = time.perf_counter()
+            fn()
+            t_wire[0] += time.perf_counter() - t
+
+        def pack(k: int):
+            """Assemble bucket k and hand its wire op to the order
+            group. MUST always register the slot — a missing start
+            would hang every rank's wait()."""
+            _, spans = self._schedule[k]
+            nm = f"{tag}:b{k}"
+            try:
+                bufs = [fetch(i)[o:o + n] for i, o, n in spans]
+                slot = self._make_slot(k, bufs, nm, wire_bytes,
+                                       wire_clock)
+            # a pack failure must not wedge THIS rank: register a no-op
+            # slot so the local wait() completes and the error surfaces
+            # (peers fail fast on their own collective timeout, exactly
+            # as with any rank fault mid-step)
+            # kflint: disable=retry-discipline
+            except Exception as e:
+                with err_mu:
+                    errors.append((nm, e))
+
+                def slot():
+                    pass
+            self._group.start(self._names[k], slot)
+
+        futs = [self._pool.submit(pack, k)
+                for k in range(len(self._schedule))]
+        # drain the packers BEFORE wait(): if a start() itself failed
+        # (group closed under us), its slot never registered and wait()
+        # would block forever — f.result() surfaces that instead. The
+        # executor runs slots as starts arrive, so waiting here costs
+        # no overlap.
+        for f in futs:
+            f.result()
+        arrival: List[str] = []
+        if self._group is not None:
+            try:
+                arrival = self._group.wait()
+            except RuntimeError as e:
+                # surface a peer-death/timeout as the KfError the
+                # survivor-recovery path catches, not a generic
+                # order-group wrapper
+                for _, te in getattr(e, "task_errors", ()):
+                    if isinstance(te, KfError):
+                        raise te from e
+                raise
+        if errors:
+            raise RuntimeError(
+                "gradient-pipeline pack failed: "
+                + "; ".join(f"{n}: {e}" for n, e in errors))
+
+        out = self._land(leaves, flats, size if average else 1)
+        wall = time.perf_counter() - t0
+        self.last_step_info = {
+            "buckets": len(self._schedule),
+            "compression": self.compression,
+            "payload_bytes": wire_bytes[0],
+            "wire_ms": t_wire[0] * 1e3,
+            "wall_ms": wall * 1e3,
+            "arrival": arrival,
+        }
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    # -- wire slots (run on the OrderGroup executor, schedule order) ---------
+
+    def _make_slot(self, k, bufs, nm, wire_bytes, wire_clock):
+        peer = self.peer
+
+        if self.compression == "none":
+            if len(bufs) == 1:
+                send = bufs[0]  # pure view: summed in place, no copy
+            else:
+                send = np.concatenate(bufs)
+
+            def slot():
+                wire_bytes[0] += send.nbytes
+                wire_clock(lambda: peer.all_reduce_inplace(
+                    send, op="sum", name=nm))
+                if len(bufs) > 1:  # scatter the coalesced tail back
+                    self._scatter(bufs, send)
+
+            return slot
+
+        # compressed: gather the bucket to f32, re-inject the residual
+        x = (np.concatenate(bufs) if len(bufs) > 1
+             else bufs[0].copy()).astype(np.float32, copy=False)
+        res = self._residual[k]
+        x += res
+
+        if self.compression == "bf16":
+            c = x.astype(_bf16_dtype())
+            res[:] = x - c.astype(np.float32)
+
+            def slot():
+                wire_bytes[0] += c.nbytes
+                wire_clock(lambda: peer.all_reduce_inplace(
+                    c, op="sum", name=nm))
+                self._scatter(bufs, c.astype(np.float32))
+
+            return slot
+
+        # int8: negotiate a shared scale (max of local amax), quantize
+        # against it, saturating-sum the payload. Each rank's range is
+        # ±(127 // np) so the SUM fits int8 without clipping (the
+        # QSGD-style budget split — log2(np) bits of precision traded,
+        # absorbed by the residual); sum_sat still guards the np > 127
+        # pathological case. Quantization happens inside the slot
+        # because it needs the negotiated scale; the residual then
+        # reflects exactly what the wire dropped.
+        local_amax = float(np.max(np.abs(x))) if x.size else 0.0
+
+        def slot():
+            s = np.array([local_amax], np.float32)
+            wire_bytes[0] += s.nbytes
+            wire_clock(lambda: peer.all_reduce_inplace(
+                s, op="max", name=f"{nm}:s"))
+            qmax = max(1, 127 // max(1, peer.size))
+            scale = float(s[0]) / qmax or 1.0
+            q = np.clip(np.rint(x / scale), -qmax, qmax).astype(np.int8)
+            res[:] = x - q.astype(np.float32) * scale
+            wire_bytes[0] += q.nbytes
+            wire_clock(lambda: peer.all_reduce_inplace(
+                q, op="sum_sat", name=f"{nm}:q"))
+            self._scatter(bufs, q.astype(np.float32) * scale)
+
+        return slot
+
+    @staticmethod
+    def _scatter(bufs, decoded: np.ndarray):
+        """Land a decoded/coalesced bucket back into the leaf views."""
+        o = 0
+        for b in bufs:
+            b[:] = decoded[o:o + b.size]
+            o += b.size
+
+    def _land(self, leaves, flats, divisor: int) -> List[np.ndarray]:
+        """Reshape the summed flat buffers into output leaves, applying
+        the mean divisor to float leaves (integer gradients — unusual,
+        but legal under ``none`` — stay sums)."""
+        out = []
+        for i, shape in enumerate(self._shapes):
+            dt = self._dtypes[i]
+            flat = flats[i]
+            if flat is None:  # zero-size leaf: no spans touched it
+                out.append(np.zeros(shape, dtype=dt))
+                continue
+            a = flat.reshape(shape)
+            if divisor != 1 and np.issubdtype(dt, np.inexact):
+                a = (a / np.asarray(divisor, dtype=dt)
+                     if dt != np.dtype(np.float32)
+                     else a / np.float32(divisor))
+            out.append(a)
+        return out

@@ -40,8 +40,12 @@ enum {
     KF_F64 = 11,
 };
 
-/* reduce op codes */
-enum { KF_SUM = 0, KF_MIN = 1, KF_MAX = 2, KF_PROD = 3 };
+/* reduce op codes. KF_SUM_SAT is the compressed-gradient accumulate:
+ * integer dtypes clamp at the dtype bounds instead of wrapping (the sum
+ * of int8-quantized gradient shards must degrade to clipping — absorbed
+ * by error feedback — never to sign-flipped wraparound); float dtypes
+ * behave exactly like KF_SUM (they already saturate at +/-inf). */
+enum { KF_SUM = 0, KF_MIN = 1, KF_MAX = 2, KF_PROD = 3, KF_SUM_SAT = 4 };
 
 /* all-reduce topology strategies */
 enum {

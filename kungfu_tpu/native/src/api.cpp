@@ -250,7 +250,7 @@ void kf_order_group_free(kf_order_group *g) {
 int kf_accumulate(void *dst, const void *src, int64_t count, int dtype,
                   int op, int force_scalar) {
     if (!dst || !src || count < 0 || dtype < 0 || dtype > int(Dtype::f64) ||
-        op < 0 || op > int(ROp::prod))
+        op < 0 || op > int(ROp::sum_sat))
         return KF_ERR_ARG;
     if (force_scalar)
         reduce_accumulate_scalar(dst, src, count, Dtype(dtype), ROp(op));

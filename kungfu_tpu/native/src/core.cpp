@@ -5,7 +5,9 @@
 #include <cstdarg>
 #include <cstdlib>
 #include <ctime>
+#include <limits>
 #include <mutex>
+#include <type_traits>
 
 #include "halffloat.hpp"
 
@@ -62,11 +64,33 @@ size_t dtype_size(Dtype dt) {
 
 namespace {
 
+// Saturating integer add: clamp at the dtype bounds instead of wrapping.
+// Quantized-gradient sums must degrade to clipping (absorbed by the
+// error-feedback residual) — a wrapped sum flips the gradient's sign.
+template <typename T>
+inline T sat_add(T a, T b) {
+    T r;
+    if (!__builtin_add_overflow(a, b, &r))
+        return r;
+    return b > 0 ? std::numeric_limits<T>::max()
+                 : std::numeric_limits<T>::min();
+}
+
 template <typename T>
 void accumulate_typed(T *dst, const T *src, int64_t n, ROp op) {
     switch (op) {
         case ROp::sum:
             for (int64_t i = 0; i < n; i++) dst[i] = T(dst[i] + src[i]);
+            break;
+        case ROp::sum_sat:
+            if constexpr (std::is_integral<T>::value) {
+                for (int64_t i = 0; i < n; i++)
+                    dst[i] = sat_add(dst[i], src[i]);
+            } else {
+                // floats saturate at +/-inf already: identical to sum
+                for (int64_t i = 0; i < n; i++)
+                    dst[i] = T(dst[i] + src[i]);
+            }
             break;
         case ROp::min:
             for (int64_t i = 0; i < n; i++)
@@ -89,6 +113,7 @@ void accumulate_16bit_float(uint16_t *dst, const uint16_t *src, int64_t n,
         float a = FromBits(dst[i]), b = FromBits(src[i]), r;
         switch (op) {
             case ROp::sum:
+            case ROp::sum_sat:  // floats saturate at +/-inf already
                 r = a + b;
                 break;
             case ROp::min:

@@ -40,7 +40,7 @@ enum class Dtype : int {
     f64 = 11,
 };
 
-enum class ROp : int { sum = 0, min = 1, max = 2, prod = 3 };
+enum class ROp : int { sum = 0, min = 1, max = 2, prod = 3, sum_sat = 4 };
 
 size_t dtype_size(Dtype dt);
 

@@ -149,6 +149,24 @@ KF_BF16_KERNEL(bf16_max, KF_VMAX_PS, b > a ? b : a)
 KF_BF16_KERNEL(bf16_prod, _mm256_mul_ps, a *b)
 #undef KF_BF16_KERNEL
 
+// -------------------------------------------------------------- i8 sat
+// Saturating int8 accumulate — the compressed-gradient wire kernel
+// (VPADDSB clamps at ±127 exactly like the scalar sat_add path).
+
+__attribute__((target("avx2"))) void i8_sum_sat(int8_t *d, const int8_t *s,
+                                                int64_t n) {
+    int64_t i = 0;
+    for (; i + 32 <= n; i += 32) {
+        __m256i a = _mm256_loadu_si256((const __m256i *)(d + i));
+        __m256i b = _mm256_loadu_si256((const __m256i *)(s + i));
+        _mm256_storeu_si256((__m256i *)(d + i), _mm256_adds_epi8(a, b));
+    }
+    for (; i < n; i++) {
+        int v = int(d[i]) + int(s[i]);
+        d[i] = int8_t(v > 127 ? 127 : (v < -128 ? -128 : v));
+    }
+}
+
 // ------------------------------------------------------------- f32 / f64
 
 #define KF_F32_KERNEL(NAME, VOP, SOP)                                       \
@@ -199,11 +217,17 @@ bool reduce_accumulate_simd(void *dst, const void *src, int64_t count,
                             Dtype dt, ROp op) {
     if (!cpu_has_avx2_f16c()) return false;
     switch (dt) {
+        case Dtype::i8: {
+            if (op != ROp::sum_sat) return false;  // others: portable loop
+            i8_sum_sat((int8_t *)dst, (const int8_t *)src, count);
+            return true;
+        }
         case Dtype::f16: {
             auto *d = (uint16_t *)dst;
             auto *s = (const uint16_t *)src;
             switch (op) {
-                case ROp::sum: f16_sum(d, s, count); return true;
+                case ROp::sum:
+                case ROp::sum_sat: f16_sum(d, s, count); return true;
                 case ROp::min: f16_min(d, s, count); return true;
                 case ROp::max: f16_max(d, s, count); return true;
                 case ROp::prod: f16_prod(d, s, count); return true;
@@ -214,7 +238,8 @@ bool reduce_accumulate_simd(void *dst, const void *src, int64_t count,
             auto *d = (uint16_t *)dst;
             auto *s = (const uint16_t *)src;
             switch (op) {
-                case ROp::sum: bf16_sum(d, s, count); return true;
+                case ROp::sum:
+                case ROp::sum_sat: bf16_sum(d, s, count); return true;
                 case ROp::min: bf16_min(d, s, count); return true;
                 case ROp::max: bf16_max(d, s, count); return true;
                 case ROp::prod: bf16_prod(d, s, count); return true;
@@ -225,7 +250,8 @@ bool reduce_accumulate_simd(void *dst, const void *src, int64_t count,
             auto *d = (float *)dst;
             auto *s = (const float *)src;
             switch (op) {
-                case ROp::sum: f32_sum(d, s, count); return true;
+                case ROp::sum:
+                case ROp::sum_sat: f32_sum(d, s, count); return true;
                 case ROp::min: f32_min(d, s, count); return true;
                 case ROp::max: f32_max(d, s, count); return true;
                 case ROp::prod: f32_prod(d, s, count); return true;
@@ -236,7 +262,8 @@ bool reduce_accumulate_simd(void *dst, const void *src, int64_t count,
             auto *d = (double *)dst;
             auto *s = (const double *)src;
             switch (op) {
-                case ROp::sum: f64_sum(d, s, count); return true;
+                case ROp::sum:
+                case ROp::sum_sat: f64_sum(d, s, count); return true;
                 case ROp::min: f64_min(d, s, count); return true;
                 case ROp::max: f64_max(d, s, count); return true;
                 case ROp::prod: f64_prod(d, s, count); return true;

@@ -85,6 +85,34 @@ void run_rank(Peer *p, int rank, std::atomic<int> *failures) {
         }
     }
 
+    // compressed-gradient wire round: per-bucket scale negotiation
+    // (f32 max) followed by a saturating int8 payload sum — the
+    // bucketed grad-pipeline protocol, under the sanitizers. Values
+    // chosen so lane 0 saturates (+127 clamp) and lane 1 does not.
+    for (int b = 0; b < 3; b++) {
+        char sname[32], qname[32];
+        std::snprintf(sname, sizeof(sname), "gb:%d:s", b);
+        std::snprintf(qname, sizeof(qname), "gb:%d:q", b);
+        float amax = float(rank + 1), amax_out = 0;
+        std::vector<int8_t> q(257, int8_t(100));
+        q[1] = int8_t(rank - 2);
+        std::shared_lock<std::shared_mutex> lk(p->session_mu());
+        int rc = p->session()->all_reduce(&amax, &amax_out, 1, Dtype::f32,
+                                          ROp::max, sname);
+        int rc2 = p->session()->all_reduce(q.data(), q.data(),
+                                           int64_t(q.size()), Dtype::i8,
+                                           ROp::sum_sat, qname);
+        int sum1 = 0;
+        for (int r = 0; r < NP; r++) sum1 += r - 2;
+        if (rc != 0 || rc2 != 0 || amax_out != float(NP) || q[0] != 127 ||
+            q[1] != int8_t(sum1)) {
+            std::fprintf(stderr, "rank %d gb:%d rc=%d/%d amax=%f q0=%d\n",
+                         rank, b, rc, rc2, double(amax_out), int(q[0]));
+            ++*failures;
+            return;
+        }
+    }
+
     // store save + barrier
     p->store.save("blob", buf.data(), 16);
     {

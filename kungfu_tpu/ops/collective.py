@@ -210,6 +210,65 @@ def chunk_schedule(tree_like, chunk_bytes: int) -> List[List[Tuple[int,
     return chunks
 
 
+# -- gradient bucketing ------------------------------------------------------
+# chunk_schedule above is byte-oriented: broadcast copies bytes, so
+# mixed-dtype spans can share a chunk. A gradient ALL-REDUCE sums typed
+# elements, so its buckets must be dtype-homogeneous and element-aligned
+# — and they fill in REVERSE leaf order, because backward produces the
+# output-side gradients first (PyTorch DDP's reverse-registration
+# bucketing, Li et al. 2020): the pipeline can put bucket 0 on the wire
+# while the input-side backward is still running.
+
+
+def bucket_schedule(tree_like, bucket_bytes: int) -> List[Tuple[
+        "np.dtype", List[Tuple[int, int, int]]]]:
+    """Partition a gradient pytree into fixed-byte all-reduce buckets.
+
+    Returns a list of buckets; each bucket is ``(dtype, spans)`` where
+    spans are ``(leaf_index, elem_offset, n_elems)`` covering every
+    element of every leaf exactly once, leaves taken in REVERSE leaf
+    order (the order backward produces them). Schedule-only — derived
+    from shapes/dtypes, so every rank computes the identical schedule
+    (and therefore the identical bucket launch order) from its own
+    `tree_like`.
+
+    Built on `chunk_schedule`: reversed leaves are split into maximal
+    same-dtype runs and each run is chunked with `bucket_bytes` rounded
+    down to an element multiple, so the layout rules carry over (a
+    >= bucket-sized leaf opens fresh and its full slices are
+    single-span — zero-copy views end to end; small leaves coalesce).
+    """
+    import numpy as np
+
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive: {bucket_bytes}")
+    leaves = jax.tree_util.tree_leaves(tree_like)
+    n = len(leaves)
+    rev = list(reversed(leaves))
+
+    def leaf_dtype(l):
+        dt = getattr(l, "dtype", None)
+        return np.dtype(dt) if dt is not None else np.asarray(l).dtype
+
+    out: List[Tuple[np.dtype, List[Tuple[int, int, int]]]] = []
+    run_start = 0
+    while run_start < n:
+        dt = leaf_dtype(rev[run_start])
+        run_end = run_start
+        while run_end < n and leaf_dtype(rev[run_end]) == dt:
+            run_end += 1
+        run = rev[run_start:run_end]
+        esz = dt.itemsize
+        per_bucket = max(1, bucket_bytes // esz) * esz
+        for spans in chunk_schedule(run, per_bucket):
+            elem_spans = [(n - 1 - (run_start + i), off // esz, nb // esz)
+                          for i, off, nb in spans if nb > 0]
+            if elem_spans:
+                out.append((dt, elem_spans))
+        run_start = run_end
+    return out
+
+
 def subtree_shapes(tree) -> List[Tuple]:
     return [l.shape for l in jax.tree_util.tree_leaves(tree)]
 

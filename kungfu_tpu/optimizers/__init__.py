@@ -8,6 +8,10 @@ designed to run *inside* the jitted SPMD train step (under `shard_map` over
 a mesh axis), so the communication compiles onto ICI.
 
 - `sync_sgd` — synchronous S-SGD: pmean of gradients (Horovod-equivalent).
+- `sync_sgd_bucketed` — S-SGD with the pmean issued as fixed-byte
+  reverse-backward-order buckets (the ICI mirror of the DCN
+  `kungfu_tpu.grad_pipeline`); bitwise-identical values, fewer and
+  larger collectives.
 - `sma` — synchronous model averaging (SMA/EA-SGD): per-step weight
   averaging blended with factor alpha, overlapped with local updates.
 - `pair_averaging` — AD-PSGD's ICI-native form: rotating ring-gossip
@@ -29,13 +33,16 @@ from .monitors import (
     monitor_gradient_variance,
 )
 from .sma_sgd import sma
-from .sync_sgd import sync_sgd
+from .sync_sgd import (bucketed_all_reduce_mean, sync_sgd,
+                       sync_sgd_bucketed)
 
 __all__ = [
     "flatten_optimizer",
     "group_small_leaves",
     "SMALL_LEAF_ELEMS",
     "sync_sgd",
+    "sync_sgd_bucketed",
+    "bucketed_all_reduce_mean",
     "sma",
     "pair_averaging",
     "PairAveragingState",

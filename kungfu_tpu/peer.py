@@ -189,6 +189,14 @@ class Peer:
         return x.copy() if self._native is None else self._native.all_reduce(
             x, op=op, name=name)
 
+    def all_reduce_inplace(self, x, op="sum", name=""):
+        """All-reduce INTO `x` (no landing copy; see
+        `NativePeer.all_reduce_inplace`). Single-process: no-op.
+        Returns `x`."""
+        if self._native is not None:
+            self._native.all_reduce_inplace(x, op=op, name=name)
+        return x
+
     def broadcast(self, x, root=0, name=""):
         return x.copy() if self._native is None else self._native.broadcast(
             x, root=root, name=name)

@@ -69,6 +69,18 @@ for np in 1 2 3 4; do
   done
 done
 
+echo "== [4b/7] gradient-pipeline smoke: 4-peer bucketed + compressed =="
+# the per-step DCN gradient path (docs/grad_pipeline.md): reverse-
+# backward buckets overlapped with a simulated backward, int8-EF
+# compressed wire (scale negotiation + saturating sum) over 4 peers
+timeout 180 python -m kungfu_tpu.run \
+  -np 4 -H 127.0.0.1:4 -port-range 26000-26999 \
+  -logdir .kf-ci-logs -q \
+  -- python -m kungfu_tpu.benchmarks.allreduce --grad-worker \
+     --model mlp-mnist --steps 2 --warmup 1 --pipeline bucketed \
+     --compress int8 --backward-ms 50 --bucket-mb 0.1 \
+  || { echo "GRAD PIPELINE SMOKE FAILED"; exit 1; }
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

@@ -3,7 +3,9 @@
 #
 # Builds the in-proc multi-peer smoke driver (4-peer loopback cluster:
 # concurrent named allreduce rounds, non-root broadcast, in-place
-# broadcast via send==recv aliasing inside Session::broadcast, store
+# broadcast via send==recv aliasing inside Session::broadcast, the
+# compressed-gradient wire round — per-bucket f32 scale negotiation +
+# saturating int8 sum_sat payload, the grad-pipeline protocol — store
 # ops, epoch switch) under each sanitizer and loops it, so the threaded
 # transport/session/peer paths — the class the round-7 Server::stop
 # hang lived in — are exercised under instrumentation, with suppression

@@ -44,3 +44,21 @@ def test_np2_fused_auto_smoke():
                   fuse=True, port_range=_free_port_range())
     assert row["tensors"] == 1         # fused: one packed buffer
     assert row["rate_gbps"] > 0
+
+
+def test_np2_grad_pipeline_smoke():
+    """The gradient-pipeline benchmark end to end at np=2: bucketed
+    int8-EF over real kfrun workers, with overlap and compression
+    visible in the published row."""
+    from kungfu_tpu.benchmarks.allreduce import run_grad_one
+
+    row = run_grad_one(2, "mlp-mnist", steps=2, warmup=1,
+                       pipeline="bucketed", compress="int8",
+                       backward_ms=40.0, bucket_mb=0.1,
+                       port_range=_free_port_range())
+    assert row["np"] == 2
+    assert row["pipeline"] == "bucketed"
+    assert row["buckets"] >= 2
+    # int8 + per-bucket scale: ~4x fewer wire bytes than the f32 model
+    assert row["payload_mb_per_step"] < 0.3 * row["model_mb"]
+    assert row["step_ms"] >= row["backward_ms"]

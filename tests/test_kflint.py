@@ -208,6 +208,81 @@ def test_axis_quiet_on_matching_and_dynamic_names():
     assert findings == []
 
 
+def test_axis_fires_on_partial_wrapped_body():
+    """shard_map(partial(body, ...), ...) must resolve THROUGH the
+    partial: a bad literal axis inside the wrapped body, a bad literal
+    bound to axis_name=, and the partial-adjusted arity all fire."""
+    findings = fire(AxisConsistencyPass(), """
+        import functools
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x, bucket_bytes):
+            return lax.psum(x, "modle")  # typo, behind the partial
+
+        def build(mesh):
+            return jax.shard_map(
+                functools.partial(body, bucket_bytes=1024), mesh=mesh,
+                in_specs=(P("model"),), out_specs=P("model"))
+
+        def body2(x, axis_name):
+            return lax.psum(x, axis_name)
+
+        def build2(mesh):
+            return jax.shard_map(
+                functools.partial(body2, axis_name="modle"), mesh=mesh,
+                in_specs=(P("model"),), out_specs=P("model"))
+
+        def body3(x, y, bucket_bytes):
+            return x + y
+
+        def build3(mesh):
+            return jax.shard_map(
+                functools.partial(body3, bucket_bytes=4), mesh=mesh,
+                in_specs=(P("data"),), out_specs=P("data"))
+    """)
+    assert len(findings) == 3
+    assert "modle" in findings[0].message
+    assert "axis_name" in findings[1].message
+    assert "after partial binding" in findings[2].message
+
+
+def test_axis_quiet_on_partial_wrapped_body():
+    findings = fire(AxisConsistencyPass(), """
+        import functools
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x, axis_name, bucket_bytes):
+            return lax.psum(x, axis_name)
+
+        def build(mesh):
+            return jax.shard_map(
+                functools.partial(body, axis_name="data",
+                                  bucket_bytes=1024),
+                mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+
+        def splat(mesh, kw):
+            # **kwargs splat: arity underivable, never guessed
+            return jax.shard_map(functools.partial(body, **kw),
+                                 mesh=mesh, in_specs=(P("data"),),
+                                 out_specs=P("data"))
+
+        def kwonly(x, *, bucket_bytes):
+            return lax.psum(x, "data")
+
+        def build_kwonly(mesh):
+            # binding a KEYWORD-ONLY param must not shrink the
+            # positional arity (x still matches the one spec)
+            return jax.shard_map(
+                functools.partial(kwonly, bucket_bytes=64), mesh=mesh,
+                in_specs=(P("data"),), out_specs=P("data"))
+    """)
+    assert findings == []
+
+
 # -- trace-purity ------------------------------------------------------------
 
 

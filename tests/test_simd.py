@@ -113,3 +113,56 @@ def test_nan_survives_sum(dtype):
         d = a.copy()
         ffi.accumulate(d, b, "sum", force_scalar=force_scalar)
         assert np.all(np.isnan(d.astype(np.float32))), force_scalar
+
+
+# -- sum_sat: the compressed-gradient accumulate -----------------------------
+
+
+def test_sum_sat_int8_saturates_not_wraps():
+    """The int8 gradient wire must clamp at the dtype bounds — a
+    wrapped sum flips the gradient's sign, a clamped one only loses
+    magnitude (absorbed by the error-feedback residual)."""
+    d = np.array([100, -100, 127, -128, 0, 64], np.int8)
+    s = np.array([100, -100, 1, -1, -5, -64], np.int8)
+    got = d.copy()
+    ffi.accumulate(got, s, "sum_sat")
+    np.testing.assert_array_equal(
+        got, np.array([127, -128, 127, -128, -5, 0], np.int8))
+
+
+def test_sum_sat_int8_simd_matches_scalar_bitwise():
+    rng = np.random.default_rng(7)
+    n = 100003  # odd: vector body + scalar tail
+    a = rng.integers(-128, 128, n).astype(np.int8)
+    b = rng.integers(-128, 128, n).astype(np.int8)
+    fast, slow = a.copy(), a.copy()
+    ffi.accumulate(fast, b, "sum_sat")
+    ffi.accumulate(slow, b, "sum_sat", force_scalar=True)
+    np.testing.assert_array_equal(fast, slow)
+    exp = np.clip(a.astype(np.int16) + b.astype(np.int16),
+                  -128, 127).astype(np.int8)
+    np.testing.assert_array_equal(fast, exp)
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES, ids=str)
+def test_sum_sat_equals_sum_for_floats(dtype):
+    """Floats already saturate at +/-inf: sum_sat is bit-identical to
+    sum, so a mixed-dtype bucket schedule can use one op code."""
+    a = _rand(dtype, 4097, 8)
+    b = _rand(dtype, 4097, 9)
+    sat, plain = a.copy(), a.copy()
+    ffi.accumulate(sat, b, "sum_sat")
+    ffi.accumulate(plain, b, "sum")
+    assert np.array_equal(sat.view(np.uint8), plain.view(np.uint8))
+
+
+def test_sum_sat_unsigned_and_wide_ints():
+    d = np.array([250, 10], np.uint8)
+    s = np.array([10, 10], np.uint8)
+    ffi.accumulate(d, s, "sum_sat")
+    np.testing.assert_array_equal(d, np.array([255, 20], np.uint8))
+    d64 = np.array([np.iinfo(np.int64).max - 1, -5], np.int64)
+    s64 = np.array([10, -3], np.int64)
+    ffi.accumulate(d64, s64, "sum_sat")
+    np.testing.assert_array_equal(
+        d64, np.array([np.iinfo(np.int64).max, -8], np.int64))
