@@ -27,16 +27,46 @@ Passes (see ``docs/static_analysis.md`` for the incident rationale):
 - ``vmem-budget``        flash/fused_ce block plans must fit the VMEM
                          budget over the benchmark shape grid
 - ``lock-discipline``    writes to ``# kf: guarded_by(lock)`` state must
-                         hold the lock
+                         hold the lock (instance attrs, module globals,
+                         and closure-shared locals)
 - ``unused-imports``     pyflakes-subset import hygiene (the container
                          ships no ruff; this keeps the F401 floor)
+
+**kfverify** (``analysis/protocol/``) adds the interprocedural SPMD
+protocol layer — the PR 5 joiner wire-name deadlock class that no
+per-file pass can see:
+
+- ``wire-name-determinism``  wire names must derive only from
+                             cluster-agreed sources (epoch, agreed
+                             step, schedule index); rank/clock/env/
+                             undeclared-counter dataflow is flagged
+                             through assignments, closures and call
+                             sites
+- ``collective-order``       per-entry-point collective sequences,
+                             extracted across function boundaries;
+                             collectives under rank-divergent branches
+                             or value-dependent loops are flagged
+- ``schedule-purity``        chunk_schedule/bucket_schedule inputs
+                             must be shape-only (no tensor values, no
+                             env reads after init)
+- ``lock-order``             the whole-program lock acquisition graph
+                             must be acyclic
+
+``analysis/protocol/explore.py`` model-checks the EXTRACTED protocol
+over small rank/interleaving scopes and prints divergence traces.
 
 Suppression: ``# kflint: disable=<pass>[,<pass>]`` on the offending
 line (or the line above); ``# kflint: skip-file`` near the top of a
 file skips it entirely. ``unused-imports`` additionally honors
-``# noqa`` so existing re-export markers keep working.
+``# noqa`` so existing re-export markers keep working. Full runs audit
+the suppressions themselves: a disable that no longer suppresses a
+live finding is a ``stale-suppression`` finding (rot in the
+written-reason policy), and ``--json``/``--baseline`` give CI stable
+finding IDs to diff against.
 """
 
-from .core import Finding, Source, all_passes, run_paths, run_source
+from .core import (Finding, Source, all_passes, run_paths,
+                   run_project_texts, run_source)
 
-__all__ = ["Finding", "Source", "all_passes", "run_paths", "run_source"]
+__all__ = ["Finding", "Source", "all_passes", "run_paths",
+           "run_project_texts", "run_source"]

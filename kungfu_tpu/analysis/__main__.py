@@ -3,44 +3,180 @@
 Exit status 0 when the tree is clean, 1 when any pass fired, 2 on
 usage errors — so `scripts/run-all.sh` (and CI) can gate on it like
 any other linter.
+
+Machine-readable mode: ``--json`` emits findings with STABLE IDs
+(``pass:file:line:hash``, hash over pass+file+message so an unrelated
+edit on the same line keeps the ID), and ``--baseline FILE`` diffs the
+run against a committed baseline — CI then fails only on NEW findings
+and reports fixed ones, instead of a bare pass/fail that blocks
+landing a checker stricter than today's tree. The committed baseline
+lives at scripts/kflint_baseline.json (empty: the tree is clean).
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
+import json
 import sys
 
-from .core import all_passes, run_paths
+from .core import Finding, all_passes, run_paths
+
+
+def finding_id(f: Finding) -> str:
+    """pass:file:line:hash — the hash covers pass+file+message only,
+    so the line-independent prefix+hash key survives line churn."""
+    h = hashlib.sha1(
+        f"{f.pass_name}|{f.path}|{f.message}".encode()).hexdigest()[:8]
+    return f"{f.pass_name}:{f.path}:{f.line}:{h}"
+
+
+def _line_free(fid: str) -> str:
+    """The ID minus its line component: an edit that merely shifts a
+    baselined finding down a few lines must not turn committed debt
+    into a NEW gate failure (the hash already pins pass+file+message)."""
+    head, _, tail = fid.rpartition(":")
+    head, _, _line = head.rpartition(":")
+    return f"{head}:{tail}"
+
+
+def diff_baseline(ids, baseline):
+    """(new, fixed) finding-ID sets, reconciled on the line-free key
+    with multiplicity: a pure line shift cancels out; a second
+    instance of an identical hazard still reports as new."""
+    from collections import Counter
+
+    cur = Counter(_line_free(i) for i in ids)
+    base = Counter(_line_free(i) for i in baseline)
+    new, fixed = set(), set()
+    spare = cur - base
+    for i in sorted(ids):
+        k = _line_free(i)
+        if spare.get(k, 0) > 0:
+            spare[k] -= 1
+            new.add(i)
+    spare = base - cur
+    for i in sorted(baseline):
+        k = _line_free(i)
+        if spare.get(k, 0) > 0:
+            spare[k] -= 1
+            fixed.add(i)
+    return new, fixed
+
+
+def to_json(findings, passes, new=None, fixed=None) -> str:
+    doc = {
+        "version": 1,
+        "passes": sorted(p.name for p in passes),
+        "count": len(findings),
+        "findings": [
+            {"id": finding_id(f), "pass": f.pass_name, "path": f.path,
+             "line": f.line, "message": f.message}
+            for f in findings
+        ],
+    }
+    if new is not None:
+        doc["new"] = sorted(new)
+    if fixed is not None:
+        doc["fixed"] = sorted(fixed)
+    return json.dumps(doc, indent=2)
+
+
+def load_baseline(path: str) -> set:
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, list):
+        return set(doc)
+    if not isinstance(doc, dict):
+        # a truncated/corrupted write (e.g. `null`) must hit the
+        # exit-2 diagnostic, not an uncaught traceback
+        raise ValueError(f"baseline must be a JSON object or list, "
+                         f"got {type(doc).__name__}")
+    ids = doc.get("ids")
+    if ids is None:
+        ids = [f["id"] for f in doc.get("findings", [])]
+    return set(ids)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kungfu_tpu.analysis",
-        description="kflint: this repo's project-specific static-"
-                    "analysis suite (see docs/static_analysis.md)")
+        description="kflint+kfverify: this repo's project-specific "
+                    "static-analysis suite (see docs/static_analysis.md)")
     ap.add_argument("paths", nargs="*", default=["kungfu_tpu"],
                     help="files or directories to analyze "
                          "(default: kungfu_tpu)")
     ap.add_argument("--select", metavar="PASS[,PASS...]",
-                    help="run only these passes")
+                    help="run only these passes (also skips the "
+                         "stale-suppression audit)")
     ap.add_argument("--list", action="store_true", dest="list_passes",
                     help="list available passes and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings with stable IDs "
+                         "(pass:file:line:hash) on stdout")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="diff findings against a committed baseline: "
+                         "exit 1 only on NEW finding IDs, report fixed "
+                         "ones")
     args = ap.parse_args(argv)
 
     if args.list_passes:
         for p in all_passes():
-            print(f"{p.name:18s} {p.doc}")
+            print(f"{p.name:22s} {p.doc}")
         return 0
 
     select = args.select.split(",") if args.select else None
+    if select and args.baseline:
+        # the baseline is generated from FULL runs; diffing a subset
+        # against it would report every other pass's baseline IDs as
+        # "fixed" and invite a baseline regeneration that turns
+        # pre-existing findings into NEW failures on the next full run
+        print("kflint: --select and --baseline are mutually exclusive "
+              "(the baseline is a full-run artifact)", file=sys.stderr)
+        return 2
     try:
         findings = run_paths(args.paths or ["kungfu_tpu"], select=select)
     except FileNotFoundError as e:
         print(e, file=sys.stderr)
         return 2  # a typo'd path must not green the gate
-    for f in findings:
-        print(f)
-    n_passes = len(select) if select else len(all_passes())
+    passes = [p for p in all_passes()
+              if select is None or p.name in select]
+    n_passes = len(passes)
+
+    new = fixed = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"kflint: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2  # an unreadable baseline must not green the gate
+        new, fixed = diff_baseline({finding_id(f) for f in findings},
+                                   baseline)
+
+    if args.as_json:
+        print(to_json(findings, passes, new, fixed))
+    else:
+        for f in findings:
+            marker = ""
+            if new is not None:
+                marker = ("" if finding_id(f) in new
+                          else " [baseline]")
+            print(f"{f}{marker}")
+
+    if args.baseline:
+        if fixed:
+            print(f"kflint: {len(fixed)} baseline finding(s) fixed — "
+                  "regenerate the baseline to ratchet", file=sys.stderr)
+        if new:
+            print(f"kflint: {len(new)} NEW finding(s) vs baseline "
+                  f"({len(findings)} total, {n_passes} passes)",
+                  file=sys.stderr)
+            return 1
+        print(f"kflint: no new findings vs baseline ({n_passes} "
+              "passes)", file=sys.stderr)
+        return 0
+
     if findings:
         print(f"kflint: {len(findings)} finding(s) across {n_passes} "
               "pass(es)", file=sys.stderr)

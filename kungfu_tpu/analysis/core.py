@@ -11,8 +11,10 @@ ordering; passes only decide what is a hazard.
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
+import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set
 
@@ -43,6 +45,12 @@ class Source:
     _disabled: Dict[int, Set[str]] = field(default_factory=dict)
     _comment_only: Set[int] = field(default_factory=set)
     _noqa: Set[int] = field(default_factory=set)
+    # (comment line, pass name) pairs that suppressed a live finding —
+    # per PASS, so the dead half of a multi-pass disable still audits
+    _hits: Set = field(default_factory=set)
+    # lines holding a real COMMENT token — markers bind only here, so
+    # string literals mentioning marker syntax stay inert
+    _comments: Set[int] = field(default_factory=set)
     skip: bool = False
 
     @classmethod
@@ -52,11 +60,16 @@ class Source:
                 text = fh.read()
         src = cls(path=path, text=text, tree=ast.parse(text, path))
         src.lines = text.splitlines()
-        for i, line in enumerate(src.lines, start=1):
+        # markers are read from REAL comment tokens, not raw lines — a
+        # string literal that merely mentions '# kflint: disable=...'
+        # (this suite's own docs and messages do) must neither suppress
+        # findings on its line nor register as a stale suppression
+        for i, line in _comment_lines(text, src.lines):
+            src._comments.add(i)
             m = _DISABLE_RE.search(line)
             if m:
                 src._disabled[i] = {p.strip() for p in m.group(1).split(",")}
-                if line.lstrip().startswith("#"):
+                if src.lines[i - 1].lstrip().startswith("#"):
                     src._comment_only.add(i)
             if _NOQA_RE.search(line):
                 src._noqa.add(i)
@@ -68,11 +81,17 @@ class Source:
         """disable comments bind to their own line, or — when written
         as a whole comment line — to the statement below. A marker
         TRAILING statement N must not leak onto line N+1: the
-        justification covers its own line only."""
+        justification covers its own line only. Matches are recorded so
+        the stale-suppression audit can flag comments that no longer
+        suppress anything."""
         if pass_name in self._disabled.get(line, ()):
+            self._hits.add((line, pass_name))
             return True
-        return (line - 1 in self._comment_only
-                and pass_name in self._disabled.get(line - 1, ()))
+        if (line - 1 in self._comment_only
+                and pass_name in self._disabled.get(line - 1, ())):
+            self._hits.add((line - 1, pass_name))
+            return True
+        return False
 
     def noqa(self, line: int) -> bool:
         return line in self._noqa
@@ -109,11 +128,31 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
+def _comment_lines(text: str, lines: List[str]):
+    """(lineno, line) for every line holding a real COMMENT token;
+    falls back to every line when tokenization fails (ast.parse
+    succeeded, so that is a tokenizer limitation, not bad source)."""
+    try:
+        out = []
+        seen: Set[int] = set()
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                i = tok.start[0]
+                if i not in seen and 1 <= i <= len(lines):
+                    seen.add(i)
+                    out.append((i, lines[i - 1]))
+        return out
+    except (tokenize.TokenError, IndentationError):
+        return list(enumerate(lines, start=1))
+
+
 def all_passes() -> list:
     # imported lazily so `import kungfu_tpu.analysis` stays cheap and
     # dependency-light (vmem-budget pulls in jax only when it RUNS)
     from . import (axis_consistency, lock_discipline, retry_discipline,
                    trace_purity, unused_imports, vmem_budget)
+    from .protocol import (CollectiveOrderPass, LockOrderPass,
+                           SchedulePurityPass, WireNameDeterminismPass)
 
     return [
         retry_discipline.RetryDisciplinePass(),
@@ -122,6 +161,10 @@ def all_passes() -> list:
         lock_discipline.LockDisciplinePass(),
         unused_imports.UnusedImportsPass(),
         vmem_budget.VmemBudgetPass(),
+        WireNameDeterminismPass(),
+        CollectiveOrderPass(),
+        SchedulePurityPass(),
+        LockOrderPass(),
     ]
 
 
@@ -148,12 +191,27 @@ def run_source(pass_obj, text: str, path: str = "<fixture>") -> List[Finding]:
     return list(pass_obj.run(src))
 
 
+def run_project_texts(pass_obj, texts: Dict[str, str]) -> List[Finding]:
+    """Run one interprocedural (kfverify) pass over in-memory modules
+    — the fixture-test entry point for ``run_project`` passes.
+    ``texts`` maps pseudo-paths to source, so cross-module fixtures
+    (the point of these passes) stay inline in the test file."""
+    from .protocol.project import ProjectIndex
+
+    sources = {path: Source.parse(path, text)
+               for path, text in texts.items()}
+    return list(pass_obj.run_project(ProjectIndex(
+        {p: s for p, s in sources.items() if not s.skip})))
+
+
 def run_paths(paths: Sequence[str],
               select: Optional[Sequence[str]] = None) -> List[Finding]:
     passes = _selected(all_passes(), select)
     file_passes = [p for p in passes if hasattr(p, "run")]
     global_passes = [p for p in passes if hasattr(p, "run_global")]
+    project_passes = [p for p in passes if hasattr(p, "run_project")]
     findings: List[Finding] = []
+    sources: Dict[str, Source] = {}
     for path in iter_py_files(paths):
         try:
             src = Source.parse(path)
@@ -163,15 +221,84 @@ def run_paths(paths: Sequence[str],
             continue
         if src.skip:
             continue
+        sources[path] = src
         for p in file_passes:
             findings.extend(p.run(src))
+    if project_passes:
+        from .protocol.project import ProjectIndex
+
+        index = ProjectIndex(sources)
+        for p in project_passes:
+            findings.extend(p.run_project(index))
     for p in global_passes:
         findings.extend(p.run_global(paths))
+    if select is None and all(os.path.isdir(p) for p in paths):
+        # tree runs only: a --select subset or a single-file spot check
+        # leaves most suppressions unhit by construction (the
+        # interprocedural passes need the files a suppression's call
+        # chain crosses) and would flag them all as stale. The audit is
+        # meaningful on the tree the suppressions were written against
+        # — CI runs it on kungfu_tpu/.
+        findings.extend(stale_suppressions(
+            sources, {p.name for p in passes}))
     findings.sort(key=lambda f: (f.path, f.line, f.pass_name))
     return findings
 
 
+def stale_suppressions(sources: Dict[str, Source],
+                       known: Set[str]) -> List[Finding]:
+    """The suppression audit: every ``# kflint: disable=<pass>`` must
+    still suppress a live finding of a real pass. A disable that nothing
+    hit is rot — the hazard it justified was fixed or moved, and the
+    written reason now vouches for nothing; a disable naming an unknown
+    pass never suppressed anything to begin with. Emitted directly
+    (never suppressible): a stale suppression is removed, not layered."""
+    out: List[Finding] = []
+    for path in sorted(sources):
+        src = sources[path]
+        for line in sorted(src._disabled):
+            names = src._disabled[line]
+            unknown = sorted(n for n in names if n not in known)
+            if unknown:
+                out.append(Finding(
+                    path, line, "stale-suppression",
+                    f"disable names unknown pass(es) "
+                    f"{', '.join(unknown)} — it suppresses nothing "
+                    "(typo, or the pass was renamed)"))
+                continue
+            hit = {p for (ln, p) in src._hits if ln == line}
+            dead = sorted(names - hit)
+            if dead:
+                out.append(Finding(
+                    path, line, "stale-suppression",
+                    f"suppression for {', '.join(dead)} no "
+                    "longer matches a live finding — remove it (the "
+                    "written reason now vouches for nothing)"))
+    return out
+
+
 # -- shared AST helpers -------------------------------------------------------
+
+
+def marker_on_line(src: Source, line: int, rx) -> Optional[re.Match]:
+    """A `# kf: ...` marker bound to the statement at ``line``: on the
+    line itself, or on a pure comment line directly above (long
+    statements). A marker TRAILING the previous statement must not
+    leak down — the one binding rule shared by ``guarded_by`` and
+    ``cluster-agreed`` (lock_discipline / kfverify). Binds only to
+    real COMMENT tokens: a string literal that merely mentions marker
+    syntax must neither create a phantom guard nor whitelist a
+    counter."""
+    if 1 <= line <= len(src.lines) and line in src._comments:
+        m = rx.search(src.lines[line - 1])
+        if m:
+            return m
+    if 2 <= line <= len(src.lines) + 1 \
+            and line - 1 in src._comments:
+        above = src.lines[line - 2]
+        if above.lstrip().startswith("#"):
+            return rx.search(above)
+    return None
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:

@@ -20,6 +20,19 @@ and the mutating container methods (append/extend/insert/remove/pop/
 clear/sort/reverse/add/discard/update/setdefault/popleft/appendleft).
 ``__init__`` (for instance attrs) and module top level (for globals)
 are exempt — state born before any thread can see it needs no lock.
+
+A third scope since the gradient pipeline: CLOSURE-LOCAL state. A
+function that fans work out to packer/executor threads shares locals
+through nested defs (``flats``/``errors`` in
+`GradBucketPipeline.all_reduce`); annotating the local opts it in::
+
+    fetch_mu = threading.Lock()
+    flats = [None] * n      # kf: guarded_by(fetch_mu)
+
+Writes inside any nested def must then hold ``with fetch_mu:``; the
+defining scope's own writes are exempt (construction happens before
+the pool sees the closure), and a nested def that rebinds the name
+locally (without ``nonlocal``) shadows rather than shares.
 Reads are NOT checked (lexical analysis cannot see happens-before
 edges like thread joins or executor shutdown); this pass is for the
 write side, where an unlocked mutation is almost never intentional.
@@ -53,20 +66,12 @@ def _self_attr(node: ast.AST) -> Optional[str]:
 
 
 def _annotation_on_line(src: Source, line: int) -> Optional[str]:
-    """guarded_by marker trailing the assignment, or on a pure comment
-    line directly above it (long assignments) — a trailing marker on
-    the PREVIOUS statement must not leak down."""
-    if 1 <= line <= len(src.lines):
-        m = _GUARDED_RE.search(src.lines[line - 1])
-        if m:
-            return m.group(1)
-    if 2 <= line <= len(src.lines) + 1:
-        above = src.lines[line - 2]
-        if above.lstrip().startswith("#"):
-            m = _GUARDED_RE.search(above)
-            if m:
-                return m.group(1)
-    return None
+    """guarded_by marker bound to the assignment at ``line`` (shared
+    binding rule: core.marker_on_line)."""
+    from .core import marker_on_line
+
+    m = marker_on_line(src, line, _GUARDED_RE)
+    return m.group(1) if m else None
 
 
 class _Scope:
@@ -121,6 +126,40 @@ class LockDisciplinePass:
             # including class methods — a method mutating chaos._active
             # unlocked is the same hazard as a free function doing it
             findings.extend(self._check_globals(src, node, module_scope))
+        # closure-local guarded state, in every function anywhere
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_closures(src, node))
+        return findings
+
+    def _check_closures(self, src: Source,
+                        fn: ast.AST) -> List[Finding]:
+        """Annotated locals of ``fn`` must be written under their lock
+        inside any nested def (the defining scope itself is exempt —
+        construction precedes the threads)."""
+        guards: Dict[str, str] = {}
+        stack = list(ast.iter_child_nodes(fn))
+        nested: List[ast.AST] = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(n)
+                continue
+            if isinstance(n, (ast.Assign, ast.AnnAssign)):
+                lock = _annotation_on_line(src, n.lineno)
+                if lock:
+                    for t in self._stmt_targets(n):
+                        if isinstance(t, ast.Name):
+                            guards[t.id] = lock
+            stack.extend(ast.iter_child_nodes(n))
+        if not guards:
+            return []
+        findings: List[Finding] = []
+        for d in nested:
+            scope = _Scope()
+            scope.guards = guards
+            findings.extend(self._check_global_fn(
+                src, d, scope, closure=True))
         return findings
 
     # -- helpers ------------------------------------------------------------
@@ -193,10 +232,12 @@ class LockDisciplinePass:
         return findings
 
     @staticmethod
-    def _fn_scope_facts(fn: ast.AST):
-        """(global_decls, local_bindings) of ``fn``'s own scope —
-        nested defs excluded, they get their own analysis."""
-        decls, bound = set(), set()
+    def _fn_scope_facts(fn: ast.AST, closure: bool = False):
+        """(shared_decls, local_bindings) of ``fn``'s own scope —
+        nested defs excluded, they get their own analysis. The shared
+        declaration keyword is ``global`` for module guards and
+        ``nonlocal`` for closure-local guards."""
+        decls, other, bound = set(), set(), set()
         a = fn.args
         for p in a.posonlyargs + a.args + a.kwonlyargs:
             bound.add(p.arg)
@@ -213,17 +254,24 @@ class LockDisciplinePass:
             if isinstance(n, ast.Lambda):
                 continue
             if isinstance(n, ast.Global):
-                decls.update(n.names)
+                (other if closure else decls).update(n.names)
+            elif isinstance(n, ast.Nonlocal):
+                (decls if closure else other).update(n.names)
             elif isinstance(n, (ast.Name,)) and isinstance(
                     n.ctx, ast.Store):
                 bound.add(n.id)
             stack.extend(ast.iter_child_nodes(n))
-        return decls, bound - decls
+        # the OTHER keyword's names are exempt like locals: `nonlocal`
+        # can never bind a module global (and `global` never a closure
+        # local), so a same-named declaration shadows the guarded
+        # scope rather than sharing it
+        return decls, (bound | other) - decls
 
     def _check_global_fn(self, src: Source, fn: ast.AST,
-                         scope: _Scope) -> List[Finding]:
+                         scope: _Scope,
+                         closure: bool = False) -> List[Finding]:
         findings: List[Finding] = []
-        decls, local = self._fn_scope_facts(fn)
+        decls, local = self._fn_scope_facts(fn, closure)
 
         def visit(node: ast.AST, stack: List[ast.AST]):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -232,7 +280,8 @@ class LockDisciplinePass:
                     # stack — a `with lock:` around a def does not mean
                     # the def's body runs under the lock
                     findings.extend(
-                        self._check_global_fn(src, node, scope))
+                        self._check_global_fn(src, node, scope,
+                                              closure))
                     return
             if isinstance(node, ast.Lambda):
                 visit(node.body, [])  # deferred like a nested def

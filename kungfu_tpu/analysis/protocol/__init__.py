@@ -1,0 +1,45 @@
+"""kfverify — interprocedural SPMD collective-protocol checking.
+
+kflint's per-file passes (``kungfu_tpu/analysis/``) catch hazards a
+single AST shows; the class that actually deadlocked PR 5 in
+development — a joiner naming gradient buckets from an internal
+counter while survivors used the cluster-agreed step — is a
+CROSS-FUNCTION protocol property: the name was built three frames away
+from the collective that used it. kfverify adds the interprocedural
+layer on the same framework (same CLI, same suppression policy, same
+fixture-test discipline):
+
+- ``wire-name-determinism`` — symbolic evaluation of every wire-name
+  construction site; any dataflow from rank/hostname/clock/env/
+  undeclared local counters into a name is a finding
+  (``# kf: cluster-agreed`` declares a consensus-synced counter);
+- ``collective-order``      — per-entry-point collective sequences
+  extracted across function boundaries; collectives under
+  rank-divergent branches or value-dependent loops are findings;
+- ``schedule-purity``       — functions feeding ``chunk_schedule`` /
+  ``bucket_schedule`` must be shape-only: no tensor-value reads, no
+  env reads after init;
+- ``lock-order``            — the whole-program lock acquisition graph
+  (with-nests + call chains) must be acyclic.
+
+``explore.py`` is the small-scope model checker: it runs the EXTRACTED
+protocol model over 2–3-rank interleavings of epoch switch vs
+in-flight buckets and prints divergence traces; the PR 5 deadlock is
+its first regression fixture.
+
+See docs/static_analysis.md for the pass <-> incident catalog.
+"""
+
+from .collective_order import CollectiveOrderPass
+from .lock_order import LockOrderPass
+from .project import ProjectIndex
+from .schedule_purity import SchedulePurityPass
+from .wire_names import WireNameDeterminismPass
+
+__all__ = [
+    "CollectiveOrderPass",
+    "LockOrderPass",
+    "ProjectIndex",
+    "SchedulePurityPass",
+    "WireNameDeterminismPass",
+]

@@ -1,0 +1,289 @@
+"""collective-order: every rank must issue the same wire sequence.
+
+A KungFu collective completes only when every rank issues it: a
+collective reachable on SOME ranks but not others — or a different
+number of times per rank — is a deadlock, not an error message. This
+pass walks each protocol entry point through the project call graph
+(the per-file passes cannot see that `recover` -> `recover_from_url`
+-> `_propose` -> `barrier` crosses three functions and two modules)
+and flags symmetric collectives that are:
+
+- **reachable under a rank-divergent branch**: an ``if``/``while``
+  test on ``.rank`` / ``.local_rank`` / hostname / pid / the process's
+  LAUNCH version (``config.version`` — a joiner and a survivor took
+  different values at spawn, so the branch splits the cluster);
+- **inside a loop whose trip count is value-dependent**: a ``while``
+  bounded by a wall clock, or a ``for`` over a value-read / clock /
+  rank-dependent iterable — ranks may run different iteration counts
+  and offer mismatched sequences. Loops over schedules
+  (``range(...)``, ``enumerate(chunks)``, bucket schedules) are
+  shape-derived and identical on every rank, so they stay quiet.
+
+The walk also EXTRACTS each entry point's collective call sequence
+(``self.sequences`` after a run) — the linearized model the
+small-scope explorer (`analysis/protocol/explore.py`) executes over
+rank interleavings.
+
+Suppressions must explain why the divergence is protocol-safe — the
+two live ones in the tree are the recovery poll loop (survivors run it
+OUTSIDE the lockstep protocol; `_propose`'s join barrier is the fence)
+and the joiner-side resync broadcast (matched by the survivors'
+`after_step` branch; pairing is asserted by the elastic e2e tests).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding, dotted_name
+from .project import (CLOCK_CALLS, HOST_ID_CALLS, FuncInfo,
+                      ProjectIndex)
+
+NAME = "collective-order"
+
+#: the symmetric rendezvous ops (barrier has no name but still blocks
+#: until every rank arrives)
+COLLECTIVES = {
+    "all_reduce", "all_reduce_inplace", "broadcast", "broadcast_inplace",
+    "all_gather", "reduce", "gather", "consensus", "barrier",
+}
+
+_RANK_ATTRS = {"rank", "local_rank"}
+# shared inventory (project.py) + the bare suffixes `from x import y`
+# call sites use — minus "id" (builtin id() matches exactly; the
+# suffix would flag every method named .id())
+_HOST_CALLS = HOST_ID_CALLS | (
+    {c.split(".")[-1] for c in HOST_ID_CALLS} - {"id"})
+_CLOCK_CALLS = CLOCK_CALLS | {c.split(".")[-1] for c in CLOCK_CALLS}
+_VALUE_READS = {"item", "tolist", "any", "all", "nonzero"}
+
+#: entry points: display name -> (path suffix, function qualname or
+#: None for the module top level). Missing files are skipped, so the
+#: pass degrades gracefully on partial trees.
+ENTRY_POINTS = {
+    "train-step": ("elastic/continuity_worker.py", None),
+    "bucketed-pipeline": ("grad_pipeline.py",
+                          "GradBucketPipeline.all_reduce"),
+    "resync": ("elastic/hooks.py", "ElasticCallback.resync_params"),
+    "recovery-restore": ("elastic/hooks.py", "ElasticCallback.recover"),
+}
+
+
+@dataclass(frozen=True)
+class WireSite:
+    """One collective in an entry point's extracted sequence."""
+
+    op: str
+    path: str
+    line: int
+
+
+def _test_divergence(test: ast.AST) -> Optional[str]:
+    """Why this branch/loop test may split the cluster, or None."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute):
+            if n.attr in _RANK_ATTRS:
+                return f"rank-dependent test ({dotted_name(n) or n.attr})"
+            dn = dotted_name(n) or ""
+            if dn.endswith("config.version"):
+                return ("launch-version test (a joiner and a survivor "
+                        "were spawned with different values)")
+        if isinstance(n, ast.Call):
+            cn = dotted_name(n.func) or ""
+            if cn in _HOST_CALLS or cn.split(".")[-1] in _HOST_CALLS:
+                return f"host-identity test ({cn})"
+    return None
+
+
+def _test_clock(test: ast.AST) -> Optional[str]:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            cn = dotted_name(n.func) or ""
+            if cn in _CLOCK_CALLS or cn.split(".")[-1] in _CLOCK_CALLS:
+                return f"clock-bounded loop ({cn})"
+    return None
+
+
+def _iter_value_dependent(it: ast.AST) -> Optional[str]:
+    for n in ast.walk(it):
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _VALUE_READS:
+                return f"value-read in iterable (.{n.func.attr}())"
+            cn = dotted_name(n.func) or ""
+            if cn in _CLOCK_CALLS or cn.split(".")[-1] in _CLOCK_CALLS:
+                return f"clock in iterable ({cn})"
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_ATTRS:
+            return f"rank in iterable ({dotted_name(n) or n.attr})"
+    return None
+
+
+def _deferred_callee(call: ast.Call) -> Optional[ast.AST]:
+    """submit(fn, ...) / Thread(target=fn): the function that runs the
+    work — still part of the entry point's logical wire sequence."""
+    fn = call.func
+    attr = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    if attr == "submit" and call.args:
+        return call.args[0]
+    if attr == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+    return None
+
+
+class CollectiveOrderPass:
+    name = NAME
+    doc = ("collectives reachable under rank-divergent branches or "
+           "value-dependent loops along protocol entry points")
+
+    def __init__(self, entries: Optional[Dict[str, Tuple[str,
+                                               Optional[str]]]] = None):
+        self.entries = ENTRY_POINTS if entries is None else entries
+        #: entry -> extracted collective sequence (filled by run)
+        self.sequences: Dict[str, List[WireSite]] = {}
+
+    # -- summaries -----------------------------------------------------------
+
+    def _summaries(self, index: ProjectIndex) -> Dict[int, Set[str]]:
+        """fn -> collective op names transitively reachable from it."""
+        summ: Dict[int, Set[str]] = {id(f.node): set()
+                                     for f in index.funcs}
+        direct_calls: Dict[int, List[FuncInfo]] = {}
+        for f in index.funcs:
+            for n in ast.walk(f.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                attr = (n.func.attr if isinstance(n.func, ast.Attribute)
+                        else n.func.id if isinstance(n.func, ast.Name)
+                        else None)
+                if attr in COLLECTIVES:
+                    summ[id(f.node)].add(attr)
+                for cand in index.resolve_call(n, f)[:4]:
+                    direct_calls.setdefault(id(f.node), []).append(cand)
+        for _ in range(len(index.funcs)):
+            changed = False
+            for f in index.funcs:
+                s = summ[id(f.node)]
+                before = len(s)
+                for cand in direct_calls.get(id(f.node), ()):
+                    s |= summ.get(id(cand.node), set())
+                changed |= len(s) != before
+            if not changed:
+                break
+        return summ
+
+    # -- the walk ------------------------------------------------------------
+
+    def run_project(self, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, str, int]] = set()
+        summaries = self._summaries(index)
+
+        def report(entry, src, node, why: str, ops: Sequence[str]):
+            key = (entry, src.path, node.lineno)
+            if key in seen:
+                return
+            seen.add(key)
+            f = src.finding(
+                node, NAME,
+                f"[{entry}] collective {'/'.join(sorted(ops))} "
+                f"reachable under {why} — ranks taking different paths "
+                "offer mismatched wire sequences (deadlock); restructure "
+                "so every rank issues the same sequence, or suppress "
+                "with the protocol argument for why the divergence is "
+                "safe")
+            if f:
+                findings.append(f)
+
+        def visit(entry, stmts, info: Optional[FuncInfo], src,
+                  contexts: List[str], visited: Set[int]):
+            for stmt in stmts:
+                self._visit_node(entry, stmt, info, src, contexts,
+                                 visited, report)
+
+        self._visit = visit  # for _visit_node recursion bookkeeping
+
+        for entry, (suffix, qual) in sorted(self.entries.items()):
+            src = next((s for p, s in index.sources.items()
+                        if p.replace("\\", "/").endswith(suffix)), None)
+            if src is None:
+                continue
+            self.sequences[entry] = []
+            self._seq = self.sequences[entry]
+            self._summ = summaries
+            self._index = index
+            if qual is None:
+                visit(entry, src.tree.body, None, src, [], set())
+                continue
+            cls, _, fn_name = qual.rpartition(".")
+            info = next((f for f in index.funcs
+                         if f.src is src and f.name == fn_name
+                         and (not cls or f.cls == cls)), None)
+            if info is None:
+                # a MISSING file degrades gracefully (partial trees),
+                # but a present file without the named function is a
+                # rename regression — silently un-gating the protocol
+                # path would green the CI while checking nothing (the
+                # iter_py_files typo'd-path rule, applied here)
+                findings.append(Finding(
+                    src.path, 1, NAME,
+                    f"entry point '{entry}' names {qual}, which no "
+                    f"longer exists in {src.path} — update "
+                    "ENTRY_POINTS (or the pass checks nothing on "
+                    "this protocol path)"))
+                continue
+            visit(entry, info.node.body, info, src, [],
+                  {id(info.node)})
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    def _visit_node(self, entry, node, info, src, contexts, visited,
+                    report):
+        index, summaries = self._index, self._summ
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # a def is not executed here
+        if isinstance(node, ast.Call):
+            attr = (node.func.attr if isinstance(node.func,
+                                                 ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else None)
+            if attr in COLLECTIVES:
+                self._seq.append(WireSite(attr, src.path, node.lineno))
+                if contexts:
+                    report(entry, src, node, contexts[-1], [attr])
+            targets = list(index.resolve_call(node, info)[:4])
+            deferred = _deferred_callee(node)
+            if deferred is not None:
+                fake = ast.Call(func=deferred, args=[], keywords=[])
+                ast.copy_location(fake, node)
+                targets.extend(index.resolve_call(fake, info)[:2])
+            for cand in targets:
+                reach = summaries.get(id(cand.node), set())
+                if contexts and reach:
+                    report(entry, src, node, contexts[-1], sorted(reach))
+                if id(cand.node) not in visited and reach:
+                    visited.add(id(cand.node))
+                    # callee analyzed with ITS OWN contexts: caller-side
+                    # divergence was already reported at the call site
+                    self._visit(entry, cand.node.body, cand, cand.src,
+                                [], visited)
+        new_contexts = contexts
+        if isinstance(node, (ast.If, ast.IfExp)):
+            why = _test_divergence(node.test)
+            if why:
+                new_contexts = contexts + [why]
+        elif isinstance(node, ast.While):
+            why = _test_divergence(node.test) or _test_clock(node.test)
+            if why:
+                new_contexts = contexts + [why]
+        elif isinstance(node, ast.For):
+            why = _iter_value_dependent(node.iter)
+            if why:
+                new_contexts = contexts + [why]
+        for child in ast.iter_child_nodes(node):
+            self._visit_node(entry, child, info, src, new_contexts,
+                             visited, report)

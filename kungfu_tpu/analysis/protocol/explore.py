@@ -1,0 +1,261 @@
+"""Small-scope model checker for the extracted collective protocol.
+
+The static passes prove properties of the CODE; this module executes
+the extracted MODEL — not live code, no sockets, no threads — over the
+small scopes where the historical deadlocks lived: 2–3 ranks, an epoch
+switch landing at every possible point relative to in-flight gradient
+buckets. The checker's semantics are the wire's: a symmetric
+collective completes only when every rank offers the SAME name; a
+state where offered names differ can never progress, and the
+divergence trace (who offers what, after which history) is exactly the
+stack you wish you had at the real 3 a.m. hang.
+
+First fixture — regression-encoded here and in tests/test_kflint.py —
+is the PR 5 joiner wire-name deadlock: the bucketed pipeline's names
+are ``{name}:{epoch}:{step}:bK``; the initial implementation bound
+``step`` to the pipeline object's internal call counter. A replacement
+joiner's fresh pipeline counts from 0 while survivors count from the
+steps they already ran, so the first post-regrow bucket round offers
+``kf::grad:1:0:b0`` against ``kf::grad:1:3:b0`` and the e2e chaos test
+hung. Bound to the cluster-agreed step, every interleaving completes.
+
+The bucket-name template is EXTRACTED from `grad_pipeline.py` (via the
+shared symbolic evaluator), so this model can never drift from the
+code it checks: rename a field in the real f-string and the extraction,
+the model and this module's tests all move together.
+
+Run the demo::
+
+    python -m kungfu_tpu.analysis.protocol.explore
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: name-template slot kinds, normalized from extracted parts
+NAME_F, EPOCH_F, STEP_F, BUCKET_F = "name", "epoch", "step", "bucket"
+
+
+def extract_bucket_template(index) -> List[Tuple[str, str]]:
+    """The bucketed pipeline's wire-name template, extracted from the
+    real `grad_pipeline.py` in ``index``: a list of ``(kind, text)``
+    slots with kind in {lit, name, epoch, step, bucket}. Raises when
+    the pipeline module is absent or the shape changed beyond
+    recognition — extraction drift must fail loudly, not model a
+    protocol that no longer exists."""
+    pack = next((f for f in index.funcs if f.name == "pack"
+                 and f.module.replace("\\", "/").endswith(
+                     "grad_pipeline.py")), None)
+    if pack is None:
+        raise ValueError("extract_bucket_template: no pack() in an "
+                         "analyzed grad_pipeline.py")
+    parts = index._eval_local("nm", pack, 0, set())
+    slots: List[Tuple[str, str]] = []
+    for p in parts:
+        last = p.text.split(".")[-1]
+        if p.kind == "lit":
+            slots.append(("lit", p.text))
+        elif "version" in last or "epoch" in last:
+            slots.append((EPOCH_F, p.text))
+        elif last in ("step", "_round") or p.kind == "param" \
+                and last == "step":
+            # the (param step | self._round fallback) pair is ONE slot
+            if not (slots and slots[-1][0] == STEP_F):
+                slots.append((STEP_F, p.text))
+        elif p.kind in ("param", "loop") and last == "k":
+            slots.append((BUCKET_F, p.text))
+        elif last == "name":
+            slots.append((NAME_F, p.text))
+    kinds = [k for k, _ in slots]
+    for want in (EPOCH_F, STEP_F, BUCKET_F):
+        if want not in kinds:
+            raise ValueError(
+                f"extract_bucket_template: no {want} slot in extracted "
+                f"parts {slots} — grad_pipeline's naming changed; "
+                "update the model")
+    return slots
+
+
+def render(slots: Sequence[Tuple[str, str]], *, name: str, epoch: int,
+           step: int, bucket: int) -> str:
+    out = []
+    for kind, text in slots:
+        if kind == "lit":
+            out.append(text)
+        elif kind == NAME_F:
+            out.append(name)
+        elif kind == EPOCH_F:
+            out.append(str(epoch))
+        elif kind == STEP_F:
+            out.append(str(step))
+        elif kind == BUCKET_F:
+            out.append(str(bucket))
+    return "".join(out)
+
+
+# -- the checker --------------------------------------------------------------
+
+
+@dataclass
+class Divergence:
+    """A reachable state where the ranks' offered names differ."""
+
+    at: int                      # index into the lockstep sequence
+    offers: Dict[int, Optional[str]]   # rank -> offered name (None =
+    #                                    exhausted: the others hang)
+    history: List[str] = field(default_factory=list)
+    scenario: str = ""
+
+    def trace(self) -> str:
+        lines = [f"divergence after {self.at} matched op(s)"
+                 + (f" [{self.scenario}]" if self.scenario else "")]
+        for op in self.history[-4:]:
+            lines.append(f"  matched: {op}")
+        for rank in sorted(self.offers):
+            off = self.offers[rank]
+            lines.append(f"  rank {rank} offers: "
+                         + (off if off is not None else
+                            "<nothing: program exhausted>"))
+        return "\n".join(lines)
+
+
+def check_lockstep(programs: Dict[int, List[str]],
+                   scenario: str = "") -> Optional[Divergence]:
+    """Run deterministic per-rank wire sequences under rendezvous
+    semantics: all ranks must offer the same name to advance. Returns
+    the first divergence, or None when every rank completes."""
+    i = 0
+    history: List[str] = []
+    n = max(len(p) for p in programs.values()) if programs else 0
+    while i < n:
+        offers = {r: (p[i] if i < len(p) else None)
+                  for r, p in programs.items()}
+        names = set(offers.values())
+        if len(names) != 1:
+            return Divergence(i, offers, history, scenario)
+        op = names.pop()
+        if op is None:
+            break
+        history.append(op)
+        i += 1
+    return None
+
+
+# -- the epoch-switch x in-flight-buckets scenario ----------------------------
+
+
+def grad_pipeline_programs(slots, *, ranks: int, steps: int,
+                           buckets: int, switch_step: int,
+                           switch_bucket: int, joiner_rank: int,
+                           binding: str) -> Dict[int, List[str]]:
+    """Post-regrow wire programs for every rank.
+
+    The cluster runs epoch 0 until ``switch_step`` (a peer dies at
+    bucket ``switch_bucket`` of that step), survivors redo the step in
+    epoch 1 with a replacement joiner at ``joiner_rank``. ``binding``
+    selects how the step slot is derived:
+
+    - ``"agreed"`` — the cluster-agreed step every rank shares (the
+      fix: `all_reduce(grads, step=elastic.state.step)`);
+    - ``"local-counter"`` — the pipeline object's internal call count
+      (the PR 5 bug: survivors counted every call since construction,
+      including the aborted one; the joiner's fresh pipe counts from
+      zero).
+
+    ``switch_bucket`` is where the death lands: 0 means BETWEEN steps
+    (a planned resize — no aborted call, survivors' counters equal the
+    steps they completed), > 0 means mid-step with that many buckets
+    already flown (the chaos case — the aborted attempt consumed a
+    count, because `step = self._round; self._round += 1` runs at call
+    entry). The distinction matters: under the counter binding, a
+    between-steps switch at step 0 does NOT diverge — a joiner present
+    from the first call counts in lockstep, which is exactly the
+    static-cluster contract the real `_round` fallback documents.
+    """
+    if binding not in ("agreed", "local-counter"):
+        raise ValueError(f"unknown binding {binding!r}")
+    programs: Dict[int, List[str]] = {}
+    for rank in range(ranks):
+        joined_now = rank == joiner_rank
+        # survivor call count: one per completed step, plus — only when
+        # buckets were in flight — the aborted attempt at switch_step
+        calls_made = switch_step + (1 if switch_bucket > 0 else 0)
+        ops: List[str] = []
+        for step in range(switch_step, steps):
+            if binding == "agreed":
+                tag_step = step
+            else:
+                tag_step = 0 if joined_now else calls_made
+                calls_made += 1
+                if joined_now:
+                    joined_now = False
+                    calls_made = 1
+            for k in range(buckets):
+                ops.append(render(slots, name="kf::grad", epoch=1,
+                                  step=tag_step, bucket=k))
+        programs[rank] = ops
+    return programs
+
+
+def explore_epoch_switch(binding: str, slots=None, *,
+                         ranks_scope=(2, 3), steps: int = 3,
+                         buckets: int = 2) -> List[Divergence]:
+    """Explore every (rank count, switch step, in-flight bucket,
+    joiner rank) small-scope interleaving; return all divergences."""
+    if slots is None:
+        slots = _default_slots()
+    out: List[Divergence] = []
+    for ranks in ranks_scope:
+        for switch_step in range(steps):
+            for switch_bucket in range(buckets):
+                for joiner_rank in range(ranks):
+                    programs = grad_pipeline_programs(
+                        slots, ranks=ranks, steps=steps,
+                        buckets=buckets, switch_step=switch_step,
+                        switch_bucket=switch_bucket,
+                        joiner_rank=joiner_rank, binding=binding)
+                    d = check_lockstep(
+                        programs,
+                        scenario=f"ranks={ranks} switch@step="
+                                 f"{switch_step} bucket={switch_bucket}"
+                                 f" joiner={joiner_rank} "
+                                 f"binding={binding}")
+                    if d:
+                        out.append(d)
+    return out
+
+
+def _default_slots() -> List[Tuple[str, str]]:
+    """Template extracted from the repo's own grad_pipeline.py."""
+    import os
+
+    from ..core import Source
+    from .project import ProjectIndex
+
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(here, "grad_pipeline.py")
+    return extract_bucket_template(
+        ProjectIndex({path: Source.parse(path)}))
+
+
+def main() -> int:
+    slots = _default_slots()
+    template = "".join(t if k == "lit" else "{%s}" % k
+                       for k, t in slots)
+    print(f"extracted bucket-name template: {template}")
+    print("template slots:", slots)
+    bad = explore_epoch_switch("local-counter", slots)
+    good = explore_epoch_switch("agreed", slots)
+    print(f"\nbinding=local-counter (the PR 5 bug): "
+          f"{len(bad)} divergent interleaving(s); first trace:\n")
+    if bad:
+        print(bad[0].trace())
+    print(f"\nbinding=agreed (the fix): {len(good)} divergence(s)")
+    return 1 if good or not bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,302 @@
+"""lock-order: the whole-program lock acquisition graph must be acyclic.
+
+The per-file lock-discipline pass proves annotated state is written
+UNDER its lock; it cannot see that thread 1 takes A then B while
+thread 2 — three modules away — takes B then A. This pass builds the
+acquisition graph across the analyzed tree:
+
+- **nodes** are locks, qualified by defining scope (`ffi.py::_lib_mu`,
+  `ffi.py::OrderGroup._mu`, `grad_pipeline.py::all_reduce.fetch_mu`)
+  — a module lock that merely shares an instance lock's name never
+  aliases it, the same rule lock-discipline uses;
+- **edges** A -> B when B is acquired (a lexical ``with B:``) while A
+  is held — directly in one function, or through a resolvable call
+  chain (`f` holds A and calls `g`, which acquires B, possibly
+  transitively). Calls handed to executors/threads
+  (``submit``/``Thread(target=...)``) are NOT edges: the worker runs
+  without the submitter's locks;
+- a **cycle** is the finding (two threads entering the cycle from
+  different edges deadlock); acquiring a non-reentrant ``Lock`` while
+  already held (a self-edge) is reported too — that deadlocks a single
+  thread with no second party needed.
+
+Same-class locks on different *instances* are merged into one node:
+lexical analysis cannot tell instances apart, and a consistent
+per-class ordering is the discipline worth enforcing anyway (the
+Eraser/lockset literature makes the same approximation).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding
+from .project import (FuncInfo, ProjectIndex, _modbase, lock_ctor)
+
+NAME = "lock-order"
+
+
+@dataclass(frozen=True)
+class LockDef:
+    lock_id: str
+    reentrant: bool
+
+
+class _Inventory:
+    """Every lock definition in the tree, by scope."""
+
+    def __init__(self, index: ProjectIndex):
+        self.module: Dict[Tuple[str, str], LockDef] = {}
+        self.cls: Dict[Tuple[str, str, str], LockDef] = {}
+        self.fn_local: Dict[Tuple[int, str], LockDef] = {}
+        for path, src in index.sources.items():
+            base = _modbase(path)
+            for stmt in src.tree.body:
+                if isinstance(stmt, ast.Assign) and lock_ctor(stmt.value):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.module[(path, t.id)] = LockDef(
+                                f"{base}::{t.id}",
+                                _is_rlock(stmt.value))
+        for info in index.funcs:
+            for n in ast.walk(info.node):
+                if not (isinstance(n, ast.Assign)
+                        and lock_ctor(n.value)):
+                    continue
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id == "self" \
+                            and info.cls:
+                        self.cls[(info.module, info.cls, t.attr)] = \
+                            LockDef(f"{_modbase(info.module)}::"
+                                    f"{info.cls}.{t.attr}",
+                                    _is_rlock(n.value))
+                    elif isinstance(t, ast.Name):
+                        self.fn_local[(id(info.node), t.id)] = LockDef(
+                            f"{_modbase(info.module)}::{info.name}."
+                            f"{t.id}", _is_rlock(n.value))
+
+    def resolve(self, expr: ast.AST,
+                ctx: Optional[FuncInfo]) -> Optional[LockDef]:
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self":
+            info = ctx
+            while info is not None:
+                if info.cls:
+                    d = self.cls.get((info.module, info.cls, expr.attr))
+                    if d:
+                        return d
+                info = info.parent
+            # fall back to ANY class defining this lock attr (merged
+            # node, same approximation as method resolution)
+            for (_, _, attr), d in self.cls.items():
+                if attr == expr.attr:
+                    return d
+            return None
+        if isinstance(expr, ast.Name):
+            info = ctx
+            while info is not None:
+                d = self.fn_local.get((id(info.node), expr.id))
+                if d:
+                    return d
+                info = info.parent
+            if ctx is not None:
+                return self.module.get((ctx.module, expr.id))
+        return None
+
+
+def _is_rlock(value: ast.Call) -> bool:
+    from ..core import dotted_name
+
+    return (dotted_name(value.func) or "").endswith("RLock")
+
+
+def _deferred(call: ast.Call) -> bool:
+    fn = call.func
+    attr = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    return attr in ("submit", "Thread", "Timer", "call_soon")
+
+
+@dataclass
+class _Edge:
+    src_lock: str
+    dst_lock: str
+    path: str
+    line: int
+    via: str
+
+
+class LockOrderPass:
+    name = NAME
+    doc = ("cycles in the whole-program lock acquisition graph "
+           "(with-nests + call chains across modules)")
+
+    def run_project(self, index: ProjectIndex) -> List[Finding]:
+        inv = _Inventory(index)
+        # per-function: direct acquisitions (lock, held-before, line)
+        # and calls under held locks
+        acq: Dict[int, List[Tuple[LockDef, Tuple[str, ...], int]]] = {}
+        calls: Dict[int, List[Tuple[ast.Call, Tuple[str, ...]]]] = {}
+
+        for info in index.funcs:
+            a_list: List[Tuple[LockDef, Tuple[str, ...], int]] = []
+            c_list: List[Tuple[ast.Call, Tuple[str, ...]]] = []
+
+            def walk(node, held: Tuple[str, ...], fn=info,
+                     al=a_list, cl=c_list):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    return  # separate function; fresh held set there
+                new_held = held
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        d = inv.resolve(item.context_expr, fn)
+                        if d is None:
+                            continue
+                        al.append((d, new_held, node.lineno))
+                        new_held = new_held + (d.lock_id,)
+                if isinstance(node, ast.Call):
+                    cl.append((node, new_held))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, new_held)
+
+            for stmt in info.node.body:
+                walk(stmt, ())
+            acq[id(info.node)] = a_list
+            calls[id(info.node)] = c_list
+
+        # transitive lock summaries (excluding deferred-exec calls)
+        summ: Dict[int, Set[str]] = {
+            id(f.node): {d.lock_id for d, _, _ in acq[id(f.node)]}
+            for f in index.funcs}
+        callees: Dict[int, List[FuncInfo]] = {}
+        for f in index.funcs:
+            out: List[FuncInfo] = []
+            for call, _ in calls[id(f.node)]:
+                if _deferred(call):
+                    continue
+                cands = index.resolve_call(call, f)
+                if len(cands) <= 3:
+                    out.extend(cands)
+            callees[id(f.node)] = out
+        for _ in range(len(index.funcs)):
+            changed = False
+            for f in index.funcs:
+                s = summ[id(f.node)]
+                before = len(s)
+                for c in callees[id(f.node)]:
+                    s |= summ.get(id(c.node), set())
+                changed |= len(s) != before
+            if not changed:
+                break
+
+        # edges
+        edges: List[_Edge] = []
+        self_edges: List[_Edge] = []
+        for f in index.funcs:
+            for d, held, line in acq[id(f.node)]:
+                for h in held:
+                    e = _Edge(h, d.lock_id, f.module, line,
+                              f"with-nest in {f.name}")
+                    if h == d.lock_id:
+                        if not d.reentrant:
+                            self_edges.append(e)
+                    else:
+                        edges.append(e)
+            for call, held in calls[id(f.node)]:
+                if not held or _deferred(call):
+                    continue
+                cands = index.resolve_call(call, f)
+                if len(cands) > 3:
+                    continue
+                for c in cands:
+                    for lid in summ.get(id(c.node), set()):
+                        e = _Edge(held[-1], lid, f.module, call.lineno,
+                                  f"call {f.name} -> {c.name}")
+                        if lid in held and not _reentrant(inv, lid):
+                            self_edges.append(e)
+                        elif lid not in held:
+                            edges.append(e)
+
+        findings: List[Finding] = []
+        for e in self_edges:
+            src = index.sources.get(e.path)
+            if src is None:
+                continue
+            f = src.finding(
+                e.line, NAME,
+                f"re-acquisition of non-reentrant lock {e.dst_lock} "
+                f"while already held ({e.via}) — single-thread "
+                "self-deadlock")
+            if f:
+                findings.append(f)
+        for cycle in _cycles(edges):
+            e0 = cycle[0]
+            src = index.sources.get(e0.path)
+            if src is None:
+                continue
+            # edge sites are cited module-only: finding IDs hash the
+            # message, and a line shift along the cycle must not break
+            # the baseline ratchet (the finding's own line anchors it)
+            desc = " -> ".join(
+                f"{e.src_lock} [{e.via} @{_modbase(e.path)}]"
+                for e in cycle) + f" -> {cycle[0].src_lock}"
+            f = src.finding(
+                e0.line, NAME,
+                f"lock-order cycle: {desc} — two threads entering from "
+                "different edges deadlock; pick one global order and "
+                "restructure")
+            if f:
+                findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+
+def _reentrant(inv: _Inventory, lock_id: str) -> bool:
+    for table in (inv.module, inv.cls, inv.fn_local):
+        for d in table.values():
+            if d.lock_id == lock_id:
+                return d.reentrant
+    return False
+
+
+def _cycles(edges: List[_Edge]) -> List[List[_Edge]]:
+    """One representative edge-cycle per strongly connected component
+    with a cycle (full enumeration explodes; one witness is enough to
+    fail the gate and name the locks)."""
+    graph: Dict[str, List[_Edge]] = {}
+    for e in edges:
+        graph.setdefault(e.src_lock, []).append(e)
+    out: List[List[_Edge]] = []
+    reported: Set[frozenset] = set()
+    for start in sorted(graph):
+        path: List[_Edge] = []
+        on_path: Set[str] = set()
+        seen: Set[str] = set()
+
+        def dfs(node: str) -> Optional[List[_Edge]]:
+            on_path.add(node)
+            for e in graph.get(node, ()):
+                if e.dst_lock == start and path is not None:
+                    return path + [e]
+                if e.dst_lock in on_path or e.dst_lock in seen:
+                    continue
+                path.append(e)
+                got = dfs(e.dst_lock)
+                if got:
+                    return got
+                path.pop()
+            on_path.discard(node)
+            seen.add(node)
+            return None
+
+        cyc = dfs(start)
+        if cyc:
+            key = frozenset(e.src_lock for e in cyc)
+            if key not in reported:
+                reported.add(key)
+                out.append(cyc)
+    return out

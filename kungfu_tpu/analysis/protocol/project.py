@@ -1,0 +1,473 @@
+"""kfverify plumbing: the whole-program index the protocol passes share.
+
+kflint's per-file passes see one AST at a time; the SPMD-protocol
+hazards (PR 5's joiner wire-name deadlock, lock-order inversions,
+rank-gated collectives) live in the DATAFLOW between functions and
+modules. This module parses the analyzed tree once into a
+:class:`ProjectIndex`:
+
+- every function/method (including nested defs) with its lexical
+  parent chain, so closure variables resolve;
+- a call-resolution map (bare names, ``self.method``, imported
+  ``module.func``) restricted to the analyzed set — unresolved calls
+  are treated as opaque, never guessed;
+- the **counter attributes**: every ``x.attr += <const>`` /
+  ``-= <const>`` site marks ``attr`` as a local counter (the PR 5 bug
+  class: an instance counter advances differently on a fresh joiner
+  than on a long-lived survivor);
+- the **cluster-agreed attributes**: a ``# kf: cluster-agreed``
+  annotation on the defining assignment opts a counter back in as a
+  deterministic source (it must say WHY — which consensus/sync path
+  re-agrees it; `ElasticState.step` via the `sync_position` max
+  all-reduce is the template);
+- the lock inventory (``threading.Lock/RLock/Condition`` assigned to
+  module globals, ``self.<attr>`` or function locals), qualified so
+  same-named locks in different scopes never alias.
+
+On top of the index, :func:`eval_name` is the symbolic evaluator the
+passes share: it resolves a wire-name expression (f-strings, concat,
+single-assignment locals, closure variables, parameters) into parts,
+and :func:`taint_of` classifies each resolved atom against the
+nondeterminism sources (rank, hostname, pid, clocks, RNG, env reads,
+undeclared counters).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import Source, dotted_name
+
+_AGREED_RE = re.compile(r"#\s*kf:\s*cluster-agreed")
+
+#: attribute names that identify the calling rank — never a wire name
+RANK_ATTRS = {"rank", "local_rank"}
+
+#: the ONE nondeterminism-source inventory every protocol pass derives
+#: from — a new clock/host/RNG/env source is added here once, so the
+#: checkers can never silently disagree about what counts
+CLOCK_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.clock",
+    "time.time_ns", "time.perf_counter_ns", "time.monotonic_ns",
+}
+HOST_ID_CALLS = {
+    "os.getpid", "os.getppid", "socket.gethostname", "socket.getfqdn",
+    "uuid.uuid1", "uuid.uuid4", "threading.get_ident", "id",
+}
+RNG_CALLS = {
+    "random.random", "random.randint", "random.randrange",
+    "np.random.normal", "np.random.uniform", "np.random.randint",
+    "numpy.random.normal", "numpy.random.uniform",
+}
+#: env reads: raw os + this repo's validated helpers (env.py) — for a
+#: wire name or a schedule they are equally per-process
+ENV_CALLS = {
+    "os.getenv", "os.environ.get", "env_float", "env_choice", "env_int",
+}
+
+#: calls whose result differs per process/host/moment — never a wire
+#: name ingredient (dotted suffix match)
+NONDET_CALLS = CLOCK_CALLS | HOST_ID_CALLS | RNG_CALLS | ENV_CALLS
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+
+
+@dataclass
+class FuncInfo:
+    """One function/method with enough context to resolve names."""
+
+    qual: str                    # "mod.py::Class.meth" / "mod.py::f.g"
+    module: str                  # source path
+    cls: Optional[str]
+    name: str
+    node: ast.AST                # FunctionDef / AsyncFunctionDef
+    src: Source
+    parent: Optional["FuncInfo"] = None   # lexically enclosing function
+    params: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Part:
+    """One resolved atom of a symbolically evaluated expression."""
+
+    kind: str      # "lit" | "field" | "param" | "loop" | "opaque"
+    text: str      # literal text, or the dotted source name
+    owner: Optional["FuncInfo"] = None   # param parts: whose parameter
+    #   (closure resolution may land on an ENCLOSING function's param)
+
+
+class ProjectIndex:
+    """Parsed sources + the cross-module facts the passes query."""
+
+    def __init__(self, sources: Dict[str, Source]):
+        self.sources = sources
+        self.funcs: List[FuncInfo] = []
+        self.by_simple: Dict[str, List[FuncInfo]] = {}
+        self.methods: Dict[str, List[FuncInfo]] = {}
+        self.module_funcs: Dict[str, Dict[str, FuncInfo]] = {}
+        self.imports: Dict[str, Dict[str, str]] = {}  # mod -> alias->base
+        self.counter_attrs: Set[str] = set()
+        self.agreed_attrs: Set[str] = set()
+        # class-qualified twins: facts about `self.X` resolve against
+        # the OWNING class first, so annotating ElasticState.step can
+        # never whitelist some other class's `step` counter (bare-name
+        # matching stays only for untyped chains like a.state.step)
+        self.class_counters: Dict[str, Set[str]] = {}
+        self.class_agreed: Dict[str, Set[str]] = {}
+        self.func_of_node: Dict[int, FuncInfo] = {}
+        # every Call site keyed by simple callee name, with its Source
+        # and enclosing FuncInfo precomputed — the passes' seed scans
+        # and feeder propagation are lookups here instead of repeated
+        # whole-tree ast.walk + linear enclosing-function scans
+        self.calls_by_name: Dict[str, List[Tuple[ast.Call, Source,
+                                                 Optional[FuncInfo]]]] \
+            = {}
+        for path, src in sources.items():
+            self._index_module(path, src)
+        for path, src in sources.items():
+            self._index_calls(src, src.tree, None)
+
+    # -- construction --------------------------------------------------------
+
+    def _index_module(self, path: str, src: Source) -> None:
+        base = _modbase(path)
+        self.module_funcs.setdefault(path, {})
+        self.imports.setdefault(path, {})
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    self.imports[path][alias] = a.name.split(".")[-1]
+            elif isinstance(node, ast.ImportFrom):
+                mod = (node.module or "").split(".")[-1]
+                for a in node.names:
+                    # `from pkg import mod` binds a MODULE: the name
+                    # itself is the module to resolve attributes against
+                    self.imports[path][a.asname or a.name] = mod or a.name
+        self._walk_defs(path, src, src.tree, None, None)
+        self._scan_facts(src, src.tree, None)
+
+    def _scan_facts(self, src: Source, node: ast.AST,
+                    cls: Optional[str]) -> None:
+        """Counter increments and cluster-agreed annotations, with the
+        enclosing class tracked so `self.X` facts stay class-local."""
+        for child in ast.iter_child_nodes(node):
+            inner = child.name if isinstance(child,
+                                             ast.ClassDef) else cls
+            if isinstance(child, ast.AugAssign) and isinstance(
+                    child.target, ast.Attribute) and isinstance(
+                    child.op, (ast.Add, ast.Sub)):
+                self.counter_attrs.add(child.target.attr)
+                if cls and _self_base(child.target):
+                    self.class_counters.setdefault(cls, set()).add(
+                        child.target.attr)
+            elif isinstance(child, (ast.Assign, ast.AnnAssign)) \
+                    and _has_marker(src, child.lineno, _AGREED_RE):
+                targets = (child.targets if isinstance(child, ast.Assign)
+                           else [child.target])
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.agreed_attrs.add(t.id)
+                        # a bare name at CLASS body level is a field
+                        # declaration (the dataclass form)
+                        if isinstance(node, ast.ClassDef):
+                            self.class_agreed.setdefault(
+                                node.name, set()).add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        self.agreed_attrs.add(t.attr)
+                        if cls and _self_base(t):
+                            self.class_agreed.setdefault(
+                                cls, set()).add(t.attr)
+            self._scan_facts(src, child, inner)
+
+    def _index_calls(self, src: Source, node: ast.AST,
+                     info: Optional[FuncInfo]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call):
+                fn = child.func
+                simple = (fn.attr if isinstance(fn, ast.Attribute)
+                          else fn.id if isinstance(fn, ast.Name)
+                          else None)
+                if simple:
+                    self.calls_by_name.setdefault(simple, []).append(
+                        (child, src, info))
+            self._index_calls(
+                src, child, self.func_of_node.get(id(child), info))
+
+    def _walk_defs(self, path: str, src: Source, node: ast.AST,
+                   cls: Optional[str], parent: Optional[FuncInfo]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._walk_defs(path, src, child, child.name, parent)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = (f"{_modbase(path)}::"
+                        + (f"{cls}." if cls and parent is None else "")
+                        + (f"{parent.name}." if parent else "")
+                        + child.name)
+                a = child.args
+                params = [p.arg for p in
+                          a.posonlyargs + a.args + a.kwonlyargs]
+                info = FuncInfo(qual, path, cls if parent is None
+                                else parent.cls, child.name, child, src,
+                                parent, params)
+                self.funcs.append(info)
+                self.func_of_node[id(child)] = info
+                self.by_simple.setdefault(child.name, []).append(info)
+                if cls is not None and parent is None:
+                    self.methods.setdefault(child.name, []).append(info)
+                else:
+                    self.module_funcs[path].setdefault(child.name, info)
+                self._walk_defs(path, src, child, None
+                                if parent or cls is None else cls, info)
+            else:
+                self._walk_defs(path, src, child, cls, parent)
+
+    # -- call resolution -----------------------------------------------------
+
+    def resolve_call(self, call: ast.Call,
+                     ctx: Optional[FuncInfo]) -> List[FuncInfo]:
+        """Candidate FuncInfos for ``call``, best effort: locally
+        visible defs first, then same-class methods, then project-wide
+        name matches through the import map. Unresolvable -> []."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # enclosing-function nested defs, then module functions,
+            # then from-imported project functions
+            info = ctx
+            while info is not None:
+                for cand in self.by_simple.get(fn.id, ()):
+                    if cand.parent is info:
+                        return [cand]
+                info = info.parent
+            if ctx is not None:
+                mod = self.module_funcs.get(ctx.module, {})
+                if fn.id in mod:
+                    return [mod[fn.id]]
+                if fn.id in self.imports.get(ctx.module, {}):
+                    return [c for c in self.by_simple.get(fn.id, ())
+                            if c.cls is None]
+            return [c for c in self.by_simple.get(fn.id, ())
+                    if c.cls is None][:1]
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                    and ctx is not None and ctx.cls:
+                same = [c for c in self.methods.get(fn.attr, ())
+                        if c.cls == ctx.cls]
+                if same:
+                    return same
+            if isinstance(fn.value, ast.Name):
+                # module-qualified call: `mod.f()` through the import
+                # map onto an analyzed module's top-level function
+                base = fn.value.id
+                if ctx is not None:
+                    base = self.imports.get(ctx.module, {}).get(base,
+                                                                base)
+                for path, funcs in self.module_funcs.items():
+                    if _modbase(path) == base + ".py" \
+                            and fn.attr in funcs:
+                        return [funcs[fn.attr]]
+            return list(self.methods.get(fn.attr, ()))
+        return []
+
+    # -- symbolic evaluation -------------------------------------------------
+
+    def eval_name(self, expr: ast.AST, ctx: Optional[FuncInfo],
+                  _depth: int = 0,
+                  _seen: Optional[Set[Tuple[int, str]]] = None
+                  ) -> List[Part]:
+        """Resolve a (wire-name) expression to parts. Locals follow
+        their assignments (every reaching definition contributes —
+        a conditional ``step = self._round`` must not hide behind the
+        parameter it shadows); closure variables resolve through the
+        lexical parent chain; anything else stays opaque."""
+        seen = _seen if _seen is not None else set()
+        if _depth > 8:
+            return [Part("opaque", "<depth>")]
+        if isinstance(expr, ast.Constant):
+            return [Part("lit", str(expr.value))]
+        if isinstance(expr, ast.JoinedStr):
+            out: List[Part] = []
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue):
+                    out.extend(self.eval_name(v.value, ctx, _depth + 1,
+                                              seen))
+                else:
+                    out.extend(self.eval_name(v, ctx, _depth + 1, seen))
+            return out
+        if isinstance(expr, ast.BinOp) and isinstance(
+                expr.op, (ast.Add, ast.Mod)):
+            # + concatenation and %-formatting both feed their operands
+            # into the name
+            return (self.eval_name(expr.left, ctx, _depth + 1, seen)
+                    + self.eval_name(expr.right, ctx, _depth + 1, seen))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = []
+            for e in expr.elts:
+                out.extend(self.eval_name(e, ctx, _depth + 1, seen))
+            return out
+        if isinstance(expr, ast.Subscript):
+            # an element of a tainted container is tainted — and
+            # os.environ["X"] resolves through its Attribute base
+            return self.eval_name(expr.value, ctx, _depth + 1, seen)
+        if isinstance(expr, ast.Attribute):
+            return [Part("field", dotted_name(expr) or expr.attr,
+                         owner=ctx)]
+        if isinstance(expr, ast.Call):
+            fn = expr.func
+            attr = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            # string assembly passes its receiver AND arguments into
+            # the name — matched by attribute, so "g:{}".format(rank)
+            # on a LITERAL receiver is followed, not opaque
+            if attr in ("format", "join", "encode", "str"):
+                out = []
+                if isinstance(fn, ast.Attribute):
+                    out.extend(self.eval_name(fn.value, ctx,
+                                              _depth + 1, seen))
+                for a in expr.args:
+                    out.extend(self.eval_name(a, ctx, _depth + 1,
+                                              seen))
+                return out
+            return [Part("opaque", dotted_name(fn) or "<call>")]
+        if isinstance(expr, ast.Name):
+            return self._eval_local(expr.id, ctx, _depth, seen)
+        return [Part("opaque", type(expr).__name__)]
+
+    def _eval_local(self, name: str, ctx: Optional[FuncInfo], depth: int,
+                    seen: Set[Tuple[int, str]]) -> List[Part]:
+        info = ctx
+        while info is not None:
+            key = (id(info.node), name)
+            defs = _local_defs(info.node, name)
+            is_param = name in info.params
+            if defs or is_param:
+                if key in seen:
+                    return [Part("opaque", name)]
+                seen.add(key)
+                out: List[Part] = []
+                if is_param:
+                    out.append(Part("param", name, owner=info))
+                for d in defs:
+                    if isinstance(d, ast.For):
+                        out.append(Part("loop", name))
+                    else:
+                        out.extend(self.eval_name(d, info, depth + 1,
+                                                  seen))
+                return out
+            info = info.parent
+        return [Part("opaque", name)]
+
+    # -- taint ---------------------------------------------------------------
+
+    def taint_of(self, parts: Sequence[Part]) -> List[Tuple[str, str]]:
+        """(source-kind, detail) for every nondeterministic atom in a
+        resolved name. Empty == provably agreed-or-opaque; parameters
+        are reported separately by the caller (they need call-site
+        evaluation, not a verdict here)."""
+        out: List[Tuple[str, str]] = []
+        for p in parts:
+            if p.kind == "field":
+                last = p.text.split(".")[-1]
+                if last in RANK_ATTRS:
+                    out.append(("rank", p.text))
+                elif self._is_local_counter(p):
+                    out.append(("local counter", p.text))
+                elif p.text.startswith(("os.environ",)):
+                    out.append(("env read", p.text))
+            elif p.kind == "opaque":
+                for suffix in NONDET_CALLS:
+                    # dotless entries (id, env_float) match exactly
+                    # only: suffix-matching bare `id` would flag every
+                    # accessor method named .id()
+                    if p.text == suffix or ("." in suffix
+                                            and p.text.endswith(
+                                                "." + suffix)):
+                        out.append(("nondeterministic call", p.text))
+                        break
+                else:
+                    if p.text.startswith("os.environ"):
+                        out.append(("env read", p.text))
+        return out
+
+    def _is_local_counter(self, p: Part) -> bool:
+        """Whether a field atom names an undeclared counter. `self.X`
+        with a known class resolves against THAT class's facts — an
+        annotation in one class must never whitelist another class's
+        same-named counter, and another class's counter must not taint
+        this class's plain attribute. Untyped chains (a.state.step)
+        fall back to the bare-name sets."""
+        last = p.text.split(".")[-1]
+        cls = p.owner.cls if p.owner is not None else None
+        if p.text == f"self.{last}" and cls is not None:
+            return (last in self.class_counters.get(cls, ())
+                    and last not in self.class_agreed.get(cls, ()))
+        return (last in self.counter_attrs
+                and last not in self.agreed_attrs)
+
+    def params_of(self, parts: Sequence[Part]
+                  ) -> List[Tuple[str, Optional["FuncInfo"]]]:
+        return [(p.text, p.owner) for p in parts if p.kind == "param"]
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def _modbase(path: str) -> str:
+    return path.replace("\\", "/").rsplit("/", 1)[-1]
+
+
+def _self_base(node: ast.Attribute) -> bool:
+    return isinstance(node.value, ast.Name) and node.value.id == "self"
+
+
+def _has_marker(src: Source, line: int, rx: re.Pattern) -> bool:
+    from ..core import marker_on_line
+
+    return marker_on_line(src, line, rx) is not None
+
+
+def _local_defs(fn: ast.AST, name: str) -> List[ast.AST]:
+    """Reaching definitions of ``name`` inside ``fn``'s own scope:
+    assigned values (Assign/AnnAssign/AugAssign/walrus) and For targets.
+    Nested defs are skipped — they are scopes of their own."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                if any(isinstance(e, ast.Name) and e.id == name
+                       for e in elts):
+                    out.append(n.value)
+        elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(n.target, ast.Name) and n.target.id == name \
+                    and n.value is not None:
+                out.append(n.value)
+        elif isinstance(n, ast.NamedExpr):
+            if isinstance(n.target, ast.Name) and n.target.id == name:
+                out.append(n.value)
+        elif isinstance(n, ast.For):
+            elts = (n.target.elts if isinstance(n.target, ast.Tuple)
+                    else [n.target])
+            if any(isinstance(e, ast.Name) and e.id == name
+                   for e in elts):
+                out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    return (dotted_name(value.func) or "") in _LOCK_CTORS
+
+

@@ -1,0 +1,191 @@
+"""schedule-purity: schedule inputs must be shape-only functions.
+
+`chunk_schedule` / `bucket_schedule` are the determinism anchor of the
+streaming and gradient pipelines: every rank derives the identical
+chunk/bucket layout FROM ITS OWN pytree because the schedule reads
+shapes and dtypes only. Anything value-dependent smuggled into that
+derivation — a tensor-value read (two ranks hold different gradient
+values), an env read at call time (two ranks may be configured apart),
+a clock or RNG — silently yields per-rank schedules, which means
+per-rank wire sequences, which means a hang with no error message.
+
+The pass finds every schedule call site and checks the functions
+feeding its arguments (the argument expressions' calls plus the
+reaching definitions of argument variables, one level of project
+callees deep) for:
+
+- tensor-value reads: ``.item()`` / ``.tolist()`` / ``.any()`` /
+  ``.all()`` / ``.nonzero()`` and ``np.max/min/sum/mean/abs/...``
+  reductions (shape metadata — ``np.shape``/``np.prod(shape)``/
+  ``.size``/``.itemsize`` — is exempt: that's what schedules are FOR);
+- env reads after init: ``os.environ`` / ``os.getenv`` and the
+  validated ``env_float``/``env_choice``/``env_int`` helpers. Call
+  sites inside ``__init__`` or at module top level are exempt — state
+  read once at construction is uniform for the object's lifetime; a
+  per-call read needs a suppression arguing WHY both ranks agree
+  (the launcher's CONFIG_VARS forwarding is the standard argument);
+- clocks and host RNG (the `NONDET_CALLS` set).
+
+The bodies of the schedule functions themselves are checked
+unconditionally — a value read INSIDE `bucket_schedule` would poison
+every caller at once.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ..core import Finding, dotted_name
+from .project import (CLOCK_CALLS, ENV_CALLS, RNG_CALLS, FuncInfo,
+                      ProjectIndex)
+
+NAME = "schedule-purity"
+
+SCHEDULE_FUNCS = {"chunk_schedule", "bucket_schedule"}
+
+_VALUE_METHODS = {"item", "tolist", "any", "all", "nonzero", "argmax",
+                  "argmin"}
+_NP_VALUE_FUNCS = {"max", "min", "sum", "mean", "abs", "median",
+                   "quantile", "argmax", "argmin", "any", "all"}
+_NP_BASES = {"np", "numpy", "jnp"}
+# shared inventory (project.py) + bare suffixes for from-imports —
+# minus "get" (os.environ.get's suffix would match every dict .get())
+_ENV_CALLS = (ENV_CALLS
+              | {c.split(".")[-1] for c in ENV_CALLS}) - {"get"}
+_CLOCKS = CLOCK_CALLS | RNG_CALLS
+
+
+def _violations(fn_node: ast.AST) -> List[Tuple[int, str]]:
+    """(line, description) of impurities in one function body."""
+    out: List[Tuple[int, str]] = []
+    # os.environ["X"] contains BOTH a Subscript and its Attribute base,
+    # and os.environ.get() both a matched Call and the os.environ
+    # attribute inside its func chain — each hazard reports ONCE, from
+    # the outermost matching construct (ast.walk yields parents first,
+    # so reported_under is populated before the inner nodes arrive)
+    sub_bases = {id(n.value) for n in ast.walk(fn_node)
+                 if isinstance(n, ast.Subscript)}
+    reported_under: set = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _VALUE_METHODS:
+                out.append((n.lineno,
+                            f"tensor-value read .{n.func.attr}()"))
+                continue
+            cn = dotted_name(n.func) or ""
+            head, _, tail = cn.rpartition(".")
+            if head in _NP_BASES and tail in _NP_VALUE_FUNCS:
+                out.append((n.lineno, f"tensor-value read {cn}()"))
+            elif cn in _ENV_CALLS or tail in _ENV_CALLS:
+                out.append((n.lineno, f"env read {cn}()"))
+                reported_under.update(
+                    id(a) for a in ast.walk(n.func))
+            elif cn in _CLOCKS:
+                out.append((n.lineno, f"nondeterministic call {cn}()"))
+        elif isinstance(n, ast.Attribute):
+            if (dotted_name(n) or "").startswith("os.environ") \
+                    and id(n) not in sub_bases \
+                    and id(n) not in reported_under:
+                out.append((n.lineno, "env read os.environ"))
+        elif isinstance(n, ast.Subscript):
+            if (dotted_name(n.value) or "") == "os.environ":
+                out.append((n.lineno, "env read os.environ[...]"))
+    return out
+
+
+def _feeder_functions(index: ProjectIndex, arg: ast.AST,
+                      ctx: Optional[FuncInfo]) -> List[FuncInfo]:
+    """Project functions whose result feeds this argument: calls in
+    the expression itself plus calls in the reaching definitions of
+    argument variables (one assignment hop)."""
+    exprs: List[ast.AST] = [arg]
+    if isinstance(arg, ast.Name) and ctx is not None:
+        from .project import _local_defs
+
+        info = ctx
+        while info is not None:
+            defs = _local_defs(info.node, arg.id)
+            if defs or arg.id in info.params:
+                exprs.extend(d for d in defs
+                             if not isinstance(d, ast.For))
+                break
+            info = info.parent
+    out: List[FuncInfo] = []
+    for e in exprs:
+        for n in ast.walk(e):
+            if isinstance(n, ast.Call):
+                out.extend(index.resolve_call(n, ctx)[:2])
+    return out
+
+
+class SchedulePurityPass:
+    name = NAME
+    doc = ("value/env/clock reads feeding chunk_schedule/"
+           "bucket_schedule (per-rank schedules = deadlock)")
+
+    def run_project(self, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def report(src, line, msg):
+            key = (src.path, line, msg)
+            if key in seen:
+                return
+            seen.add(key)
+            f = src.finding(line, NAME, msg)
+            if f:
+                findings.append(f)
+
+        # the schedule functions' own bodies, unconditionally
+        for fname in sorted(SCHEDULE_FUNCS):
+            for info in index.by_simple.get(fname, ()):
+                for line, what in _violations(info.node):
+                    report(info.src, line,
+                           f"{what} inside {fname}() — the schedule "
+                           "must derive from shapes/dtypes only, or "
+                           "every caller's ranks diverge")
+
+        # call sites: the functions feeding the arguments
+        for attr in sorted(SCHEDULE_FUNCS):
+            for node, src, ctx in index.calls_by_name.get(attr, ()):
+                if ctx is not None and ctx.name == "__init__":
+                    continue  # construction-time: uniform by birth
+                if ctx is None:
+                    continue  # module top level: import-time init
+                feeders: List[FuncInfo] = []
+                for a in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    feeders.extend(_feeder_functions(index, a, ctx))
+                checked: Set[int] = set()
+                frontier = list(feeders)
+                depth = 0
+                while frontier and depth < 2:
+                    nxt: List[FuncInfo] = []
+                    for f in frontier:
+                        if id(f.node) in checked \
+                                or f.name in SCHEDULE_FUNCS:
+                            continue
+                        checked.add(id(f.node))
+                        for _line, what in _violations(f.node):
+                            # the feeder's line is NOT in the message:
+                            # finding IDs hash the message, and a line
+                            # shift in the feeder must not break the
+                            # baseline ratchet
+                            report(
+                                src, node.lineno,
+                                f"{attr}() argument fed by {f.name}() "
+                                f"({f.module}) which does a "
+                                f"{what} outside init — two ranks may "
+                                "derive different schedules; hoist the "
+                                "read to construction time or justify "
+                                "rank-uniformity in a suppression")
+                        for n in ast.walk(f.node):
+                            if isinstance(n, ast.Call):
+                                nxt.extend(
+                                    index.resolve_call(n, f)[:2])
+                    frontier = nxt
+                    depth += 1
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings

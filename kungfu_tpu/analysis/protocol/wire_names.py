@@ -1,0 +1,151 @@
+"""wire-name-determinism: every rank must derive the identical name.
+
+KungFu's DCN collectives rendezvous BY NAME: `Session` matches a
+received chunk to a pending op through the wire name, so the protocol
+only works when every rank derives the identical name sequence from
+its own local state. The PR 5 gradient pipeline deadlocked in
+development on exactly this: a joiner's fresh `GradBucketPipeline`
+named buckets from its internal step counter (0, 1, ...) while
+survivors' long-lived pipelines used the cluster-agreed step — every
+rank blocked forever offering a name no other rank would ever send
+(docs/static_analysis.md, "The PR 5 joiner wire-name deadlock").
+
+This pass symbolically evaluates every wire-name expression (the
+``name=`` argument of the symmetric collectives) through assignments,
+closures and — interprocedurally — function parameters, and flags any
+dataflow from a nondeterministic source:
+
+- ``.rank`` / ``.local_rank`` (identifies the caller);
+- hostname / pid / thread-id / uuid / wall clocks / host RNG;
+- ``os.environ`` reads (two ranks may disagree);
+- **undeclared local counters**: any attribute some code increments
+  (``x.attr += 1``) advances with process-local history — a fresh
+  joiner and a long-lived survivor disagree. A counter that IS
+  re-agreed by a consensus round opts back in with a
+  ``# kf: cluster-agreed`` annotation on its defining assignment
+  (`ElasticState.step`, re-agreed by `sync_position`'s max all-reduce,
+  is the template — the annotation must name the sync path).
+
+When a name derives from a parameter, every resolvable project call
+site of that function is checked with the actual argument, transitively
+— the PR 5 shape (`_make_slot(nm)` <- `pack`'s ``f"{tag}:b{k}"`` <-
+``tag`` <- ``step = self._round``) is found three frames from the
+collective.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from ..core import Finding
+from .project import FuncInfo, ProjectIndex
+
+NAME = "wire-name-determinism"
+
+#: symmetric rendezvous ops whose ``name=`` must agree across ranks.
+#: One-sided store/p2p ops (save/request/send_control) legitimately
+#: key by rank and are NOT checked.
+WIRE_METHODS = {
+    "all_reduce", "all_reduce_inplace", "broadcast", "broadcast_inplace",
+    "all_gather", "reduce", "gather", "consensus",
+}
+
+
+def _arg_for(call: ast.Call, info: FuncInfo, param: str):
+    """The actual argument bound to ``param`` at ``call``, or None."""
+    for kw in call.keywords:
+        if kw.arg == param:
+            return kw.value
+    try:
+        idx = info.params.index(param)
+    except ValueError:
+        return None
+    if info.params and info.params[0] == "self" and isinstance(
+            call.func, ast.Attribute):
+        idx -= 1
+    if 0 <= idx < len(call.args):
+        a = call.args[idx]
+        return None if isinstance(a, ast.Starred) else a
+    return None
+
+
+class WireNameDeterminismPass:
+    name = NAME
+    doc = ("wire names derived from rank/hostname/clock/env/undeclared "
+           "local counters (name-keyed rendezvous deadlock)")
+
+    def run_project(self, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        seen_lines: Set[Tuple[str, int]] = set()
+        # (func, param) whose value reaches a wire name
+        feeders: List[Tuple[FuncInfo, str]] = []
+        done_feeders: Set[Tuple[int, str]] = set()
+
+        def report(src, node, detail: str):
+            key = (src.path, node.lineno)
+            if key in seen_lines:
+                return
+            f = src.finding(node, NAME, detail)
+            if f:
+                seen_lines.add(key)
+                findings.append(f)
+
+        def check_expr(expr, ctx, src, node, via: str = ""):
+            parts = index.eval_name(expr, ctx)
+            for kind, detail in index.taint_of(parts):
+                report(src, node,
+                       f"wire name{via} derives from {kind} '{detail}' "
+                       "— ranks would offer different names and the "
+                       "name-keyed rendezvous deadlocks (declare a "
+                       "consensus-synced counter with '# kf: "
+                       "cluster-agreed', or build the name from "
+                       "epoch/agreed step/schedule index only)")
+            out = []
+            for pname, owner in index.params_of(parts):
+                owner = owner if owner is not None else ctx
+                if owner is not None:
+                    key = (id(owner.node), pname)
+                    if key not in done_feeders:
+                        done_feeders.add(key)
+                        out.append((owner, pname))
+            return out
+
+        # seed: every name argument of a symmetric collective — by
+        # keyword, or positionally through each resolvable candidate's
+        # signature (a rank-derived name passed positionally is the
+        # same deadlock; only calls to unresolvable externals with no
+        # name= stay unjudged)
+        for method in sorted(WIRE_METHODS):
+            for node, src, ctx in index.calls_by_name.get(method, ()):
+                # bare from-imported collectives are judged too — an
+                # explicit name= needs no resolution at all
+                name_args = [kw.value for kw in node.keywords
+                             if kw.arg == "name"]
+                if not name_args:
+                    for cand in index.resolve_call(node, ctx):
+                        if "name" not in cand.params:
+                            continue
+                        arg = _arg_for(node, cand, "name")
+                        if arg is not None:
+                            name_args.append(arg)
+                for name_arg in name_args:
+                    feeders.extend(check_expr(name_arg, ctx, src, node))
+
+        # propagate: a name built from a parameter is judged at every
+        # resolvable call site with the actual argument
+        while feeders:
+            fn, param = feeders.pop()
+            for node, src, ctx in index.calls_by_name.get(fn.name, ()):
+                cands = index.resolve_call(node, ctx)
+                if cands and fn not in cands:
+                    continue
+                arg = _arg_for(node, fn, param)
+                if arg is None:
+                    continue
+                feeders.extend(check_expr(
+                    arg, ctx, src, node,
+                    via=f" of {fn.name}() (via parameter "
+                        f"'{param}')"))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings

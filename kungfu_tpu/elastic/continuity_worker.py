@@ -100,9 +100,16 @@ def make_sampler():
 
 if peer.config.version > 0:
     # joiner: adopt position + weights, then PROVE the weights are
-    # trained state by comparing against this process's fresh init
+    # trained state by comparing against this process's fresh init.
+    # The launch-version branch IS rank-divergent, by protocol: these
+    # are the joiner-side halves of the resync rendezvous — survivors
+    # issue the matching sync_position/broadcast from their after_step
+    # `changed` branch below, and the pairing is asserted end to end
+    # by tests/test_elastic.py + the chaos e2e.
+    # kflint: disable=collective-order
     elastic.sync_position()
     fresh = params
+    # kflint: disable=collective-order — survivor half in `changed`
     params = broadcast_variables(params, peer=peer)
     sampler = make_sampler()
     idx = sampler.next_indices()

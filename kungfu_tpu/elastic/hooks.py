@@ -28,7 +28,13 @@ from .schedule import step_based_schedule
 
 @dataclass
 class ElasticState:
+    # both counters advance in lockstep on every member and are
+    # re-agreed by sync_position()'s max all-reduce at every epoch
+    # switch and recovery, so a joiner adopts the survivors' values
+    # before its first wire name uses them
+    # kf: cluster-agreed — re-synced via sync_position (max all-reduce)
     step: int = 0
+    # kf: cluster-agreed — re-synced via sync_position (max all-reduce)
     trained_samples: int = 0
     changed: bool = False
     keep: bool = True

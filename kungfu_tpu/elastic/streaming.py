@@ -136,6 +136,12 @@ def stream_broadcast(peer, tree, root: int = 0,
     # host leaves are contiguous numpy, so these are pure aliases —
     # received bytes land in the output buffers through them
     views = leaf_byte_views(host)
+    # the env read inside stream_chunk_bytes (KF_STREAM_CHUNK_MB) is
+    # rank-uniform by construction: the launcher forwards it to every
+    # worker via env.CONFIG_VARS, and env_float validates at parse
+    # time — the per-call read is the documented override point for
+    # the adaptation benchmark's chunk-size sweep
+    # kflint: disable=schedule-purity
     chunks = chunk_schedule(host, chunk_bytes)
     phases["chunks"] = len(chunks)
 

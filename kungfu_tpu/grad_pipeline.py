@@ -255,8 +255,10 @@ class GradBucketPipeline:
         # np.asarray on a jax leaf blocks until THAT leaf's backward is
         # done — fetching in schedule (reverse-backward) order is what
         # lets bucket 0 hit the wire while earlier layers still compute.
-        flats: List[Optional[np.ndarray]] = [None] * len(leaves)
         fetch_mu = threading.Lock()
+        # shared by every packer thread through the fetch closure
+        # kf: guarded_by(fetch_mu)
+        flats: List[Optional[np.ndarray]] = [None] * len(leaves)
 
         def fetch(i: int) -> np.ndarray:
             with fetch_mu:
@@ -282,8 +284,13 @@ class GradBucketPipeline:
                     flats[i] = a.reshape(-1)
                 return flats[i]
 
-        errors: List = []
         err_mu = threading.Lock()
+        errors: List = []  # kf: guarded_by(err_mu)
+        # wire_bytes/t_wire are written only inside wire slots, which
+        # the OrderGroup runs sequentially on its ONE executor thread;
+        # wait() is the join that publishes them to this thread — a
+        # single-owner pattern, not shared state, so no lock (the same
+        # argument as elastic/streaming.py's pipeline)
         wire_bytes = [0]
         t_wire = [0.0]
 
@@ -300,6 +307,13 @@ class GradBucketPipeline:
             nm = f"{tag}:b{k}"
             try:
                 bufs = [fetch(i)[o:o + n] for i, o, n in spans]
+                # the _round fallback inside `tag` is for STATIC
+                # clusters only, where the internal counter advances
+                # identically on every rank; elastic callers must pass
+                # the cluster-agreed step= (all_reduce docstring; the
+                # PR 5 joiner deadlock in docs/static_analysis.md is
+                # what happens otherwise, and what kfverify flags here)
+                # kflint: disable=wire-name-determinism
                 slot = self._make_slot(k, bufs, nm, wire_bytes,
                                        wire_clock)
             # a pack failure must not wedge THIS rank: register a no-op

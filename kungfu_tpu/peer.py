@@ -393,8 +393,15 @@ class Peer:
             if (stage is not None and stage.version > self._version
                     and stage.version != failed_version):
                 # _propose handles both outcomes: survivors adopt the
-                # epoch and barrier; an evicted worker fences itself
+                # epoch and barrier; an evicted worker fences itself.
+                # The clock-bounded poll is deliberately OUTSIDE the
+                # lockstep protocol: recovery runs when lockstep is
+                # already broken (a peer died mid-collective), each
+                # survivor polls independently, and _propose's join
+                # barrier is the fence proving every survivor reached
+                # the new epoch before any wire op runs in it
                 try:
+                    # kflint: disable=collective-order
                     _, keep = self._propose(stage)
                     return True, keep
                 # the whole point of this loop is surviving ANY propose

@@ -13,9 +13,16 @@ cd "$(dirname "$0")/.."
 QUICK=0
 [ "${1:-}" = "--quick" ] && QUICK=1
 
-echo "== [0/7] lint: kflint (+ruff/mypy when available) =="
-# the tree must pass its own static-analysis suite (docs/static_analysis.md)
-JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis kungfu_tpu/
+echo "== [0/7] lint: kflint + kfverify (+ruff/mypy when available) =="
+# the tree must pass its own static-analysis suite — the per-file
+# kflint passes AND the interprocedural kfverify protocol passes
+# (docs/static_analysis.md). The committed JSON baseline makes the
+# gate a diff: stable finding IDs, fail only on NEW findings, report
+# fixed ones so the baseline can ratchet down. (It is empty today —
+# the tree is clean — so this is equivalent to pass/fail until a
+# stricter pass lands with debt.)
+JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis kungfu_tpu/ \
+  --baseline scripts/kflint_baseline.json
 # pyproject.toml carries the ruff/mypy baselines; the container doesn't
 # ship them, so they gate only where installed (dev machines, CI)
 if python -c "import ruff" 2>/dev/null; then
@@ -31,7 +38,7 @@ echo "== [1/7] native build + C++ smoke =="
 make -C kungfu_tpu/native -j"$(nproc)"
 make -C kungfu_tpu/native test
 
-echo "== [2/7] sanitize: ASan/UBSan/TSan smoke loops =="
+echo "== [2/7] sanitize: C++ tidy gate + ASan/UBSan/TSan smoke loops =="
 if [ "$QUICK" = 0 ]; then
   scripts/sanitize.sh --rounds 1
 else

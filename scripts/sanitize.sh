@@ -12,28 +12,43 @@
 # files from kungfu_tpu/native/sanitize/ (policy: external roots only,
 # kf:: frames are never suppressed).
 #
-# Usage: scripts/sanitize.sh [asan|ubsan|tsan ...] [--rounds N]
-#   no flavor args = all three. Each round re-runs the full smoke on a
-#   fresh port block so leftover TIME_WAIT sockets can't alias.
+# Usage: scripts/sanitize.sh [tidy|asan|ubsan|tsan ...] [--rounds N]
+#   no flavor args = tidy + all three sanitizers. Each round re-runs
+#   the full smoke on a fresh port block so leftover TIME_WAIT sockets
+#   can't alias. `tidy` is the C++ STATIC gate (clang-tidy with the
+#   curated .clang-tidy list, cppcheck fallback, loud skip when
+#   neither tool exists) — the native sibling of the Python kflint/
+#   kfverify stage 0.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 NATIVE=kungfu_tpu/native
 ROUNDS=3
+TIDY=0
 FLAVORS=()
 while [ $# -gt 0 ]; do
   case "$1" in
     --rounds) ROUNDS="$2"; shift 2 ;;
+    tidy) TIDY=1; shift ;;
     asan|ubsan|tsan) FLAVORS+=("$1"); shift ;;
-    *) echo "usage: scripts/sanitize.sh [asan|ubsan|tsan ...] [--rounds N]" >&2
+    *) echo "usage: scripts/sanitize.sh [tidy|asan|ubsan|tsan ...]" \
+            "[--rounds N]" >&2
        exit 2 ;;
   esac
 done
-[ ${#FLAVORS[@]} -gt 0 ] || FLAVORS=(asan ubsan tsan)
+if [ "$TIDY" = 0 ] && [ ${#FLAVORS[@]} -eq 0 ]; then
+  TIDY=1
+  FLAVORS=(asan ubsan tsan)
+fi
+
+if [ "$TIDY" = 1 ]; then
+  echo "== sanitize: C++ static gate (clang-tidy / cppcheck) =="
+  make -C "$NATIVE" tidy || { echo "TIDY FAILED"; exit 1; }
+fi
 
 # distinct port blocks per flavor x round: 4 peers per run
 port=27100
-for flavor in "${FLAVORS[@]}"; do
+for flavor in ${FLAVORS[@]+"${FLAVORS[@]}"}; do
   echo "== sanitize: build $flavor (with -Werror) =="
   make -C "$NATIVE" "smoke_test_${flavor}"
   for round in $(seq 1 "$ROUNDS"); do
@@ -44,4 +59,4 @@ for flavor in "${FLAVORS[@]}"; do
   done
 done
 
-echo "SANITIZE GREEN (${FLAVORS[*]} x $ROUNDS rounds)"
+echo "SANITIZE GREEN ([tidy=$TIDY] ${FLAVORS[*]-} x $ROUNDS rounds)"

@@ -7,6 +7,7 @@ entry point the CLI uses per file — so a pass that regresses to
 never-firing fails here before it silently waves hazards through.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -14,13 +15,19 @@ import textwrap
 
 import pytest
 
-from kungfu_tpu.analysis import all_passes, run_paths, run_source
+from kungfu_tpu.analysis import (all_passes, run_paths,
+                                 run_project_texts, run_source)
 from kungfu_tpu.analysis.axis_consistency import AxisConsistencyPass
 from kungfu_tpu.analysis.lock_discipline import LockDisciplinePass
 from kungfu_tpu.analysis.retry_discipline import RetryDisciplinePass
 from kungfu_tpu.analysis.trace_purity import TracePurityPass
 from kungfu_tpu.analysis.unused_imports import UnusedImportsPass
 from kungfu_tpu.analysis import vmem_budget
+from kungfu_tpu.analysis.protocol import (CollectiveOrderPass,
+                                          LockOrderPass,
+                                          SchedulePurityPass,
+                                          WireNameDeterminismPass)
+from kungfu_tpu.analysis.protocol import explore
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "kungfu_tpu")
@@ -28,6 +35,12 @@ PKG = os.path.join(REPO, "kungfu_tpu")
 
 def fire(pass_obj, src):
     return run_source(pass_obj, textwrap.dedent(src))
+
+
+def fire_project(pass_obj, **texts):
+    return run_project_texts(
+        pass_obj, {path: textwrap.dedent(src)
+                   for path, src in texts.items()})
 
 
 # -- retry-discipline --------------------------------------------------------
@@ -548,6 +561,807 @@ def test_vmem_quiet_on_real_budget():
     assert vmem_budget.check_fused_ce() == []
 
 
+# -- kfverify: wire-name-determinism -----------------------------------------
+
+#: the PR 5 joiner deadlock, regression-encoded: an instance counter
+#: (`self._round`) flows into the bucket wire name THROUGH a closure
+#: (`tag` -> `nm`) and a method parameter (`_make_slot(nm)`) — three
+#: frames from the collective, invisible to any per-file pass
+PR5_FIXTURE = """
+    class Pipe:
+        def __init__(self, peer):
+            self.peer = peer
+            self.name = "kf::grad"
+            self._round = 0
+
+        def all_reduce(self, grads, step=None):
+            if step is None:
+                step = self._round   # the bug: joiner counts from 0
+                self._round += 1
+            tag = f"{self.name}:{self.peer.version}:{step}"
+
+            def pack(k):
+                nm = f"{tag}:b{k}"
+                slot = self._make_slot(k, nm)
+                slot()
+
+            for k in range(4):
+                pack(k)
+
+        def _make_slot(self, k, nm):
+            peer = self.peer
+
+            def slot():
+                peer.all_reduce_inplace(grads_buf, op="sum", name=nm)
+
+            return slot
+"""
+
+
+def test_wire_name_fires_on_pr5_joiner_counter():
+    findings = fire_project(WireNameDeterminismPass(),
+                            **{"grad.py": PR5_FIXTURE})
+    assert findings, "the PR 5 deadlock fixture MUST fire"
+    msgs = " ".join(f.message for f in findings)
+    assert "local counter 'self._round'" in msgs
+    assert "_make_slot" in msgs  # found through the parameter flow
+
+
+def test_wire_name_fires_on_rank_and_clock():
+    findings = fire_project(WireNameDeterminismPass(), **{"w.py": """
+        import time
+
+        def sync(peer, buf):
+            peer.all_reduce(buf, name=f"g:{peer.rank}")
+
+        def sync2(peer, buf):
+            t = time.monotonic()
+            peer.broadcast(buf, name=f"m:{t}")
+    """})
+    kinds = " ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "rank" in kinds and "time.monotonic" in kinds
+
+
+def test_wire_name_quiet_on_agreed_sources():
+    findings = fire_project(WireNameDeterminismPass(), **{"w.py": """
+        class State:
+            def __init__(self):
+                # kf: cluster-agreed — re-synced via the max all-reduce
+                self.step = 0
+
+            def advance(self):
+                self.step += 1
+
+        def sync(peer, state, bufs):
+            for k, b in enumerate(bufs):
+                peer.all_reduce(
+                    b, name=f"g:{peer.version}:{state.step}:b{k}")
+    """})
+    assert findings == []
+
+
+def test_wire_name_agreed_annotation_is_class_local():
+    # an annotation on ONE class's counter must not whitelist another
+    # class's same-named counter (found in review: bare-name matching
+    # let an annotated ElasticState.step exempt every `step` tree-wide)
+    findings = fire_project(WireNameDeterminismPass(), **{"state.py": """
+        class State:
+            def __init__(self):
+                # kf: cluster-agreed — re-synced via max all-reduce
+                self.step = 0
+
+            def advance(self):
+                self.step += 1
+    """, "pipe.py": """
+        class Pipe:
+            def __init__(self):
+                self.step = 0
+
+            def all_reduce(self, peer, buf):
+                self.step += 1
+                peer.all_reduce(buf, name=f"g:{self.step}")
+    """})
+    assert len(findings) == 1
+    assert findings[0].path == "pipe.py"
+    assert "local counter 'self.step'" in findings[0].message
+
+
+def test_wire_name_checks_call_sites_of_name_params():
+    # the name itself is a clean parameter; ONE call site feeds it a
+    # pid — the finding must land at that call site, not the wrapper
+    findings = fire_project(WireNameDeterminismPass(), **{"a.py": """
+        def wrapped(peer, buf, name):
+            peer.all_reduce(buf, name=name)
+    """, "b.py": """
+        import os
+
+        from a import wrapped
+
+        def good(peer, buf):
+            wrapped(peer, buf, "g:0")
+
+        def bad(peer, buf):
+            wrapped(peer, buf, f"g:{os.getpid()}")
+    """})
+    assert len(findings) == 1
+    assert findings[0].path == "b.py"
+    assert "os.getpid" in findings[0].message
+
+
+def test_wire_name_fires_on_env_subscript_and_percent_format():
+    # review regression: os.environ["X"] subscripts and %-formatted
+    # names were left opaque and slipped the gate silently
+    findings = fire_project(WireNameDeterminismPass(), **{"w.py": """
+        import os
+
+        class Pipe:
+            def __init__(self):
+                self._round = 0
+
+            def sync(self, peer, buf):
+                peer.all_reduce(buf, name=os.environ["KF_NAME"])
+                self._round += 1
+                peer.broadcast(buf, name="b%d" % self._round)
+    """})
+    kinds = " ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "env read" in kinds
+    assert "local counter 'self._round'" in kinds
+
+
+def test_wire_name_fires_through_format_join_and_str():
+    # review regression: .format() on a LITERAL receiver, and
+    # join/str assembly, must be followed like an f-string
+    findings = fire_project(WireNameDeterminismPass(), **{"w.py": """
+        def a(peer, buf):
+            peer.all_reduce(buf, name="g:{}".format(peer.rank))
+
+        def b(peer, buf):
+            peer.broadcast(buf, name=":".join(["g", str(peer.rank)]))
+    """})
+    assert len(findings) == 2
+    assert all("rank" in f.message for f in findings)
+
+
+def test_wire_name_fires_on_bare_imported_collective():
+    # review regression: a from-imported collective with an explicit
+    # name= must be judged like the method form
+    findings = fire_project(WireNameDeterminismPass(), **{"w.py": """
+        from peerlib import all_reduce
+
+        def sync(peer, g):
+            all_reduce(g, name=f"grad:{peer.rank}")
+    """})
+    assert len(findings) == 1
+    assert "rank" in findings[0].message
+
+
+def test_marker_in_string_literal_is_inert():
+    # review regression: marker syntax inside a STRING must neither
+    # create a phantom guard nor whitelist a counter
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        _mu = threading.Lock()
+        HELP = "annotate with  # kf: guarded_by(_mu)  on the line"
+
+        def set_help(s):
+            global HELP
+            HELP = s
+    """)
+    assert findings == []
+
+
+def test_lock_global_guard_ignores_nonlocal_shadow():
+    # review regression: `nonlocal` can never bind a module global —
+    # a same-named closure variable shadows, not shares
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        _mu = threading.Lock()
+        _active = []  # kf: guarded_by(_mu)
+
+        def outer():
+            _active = []
+
+            def inner():
+                nonlocal _active
+                _active.append(1)  # outer's local, not the global
+
+            inner()
+            return _active
+    """)
+    assert findings == []
+
+
+def test_wire_name_quiet_on_id_accessor_methods():
+    # review regression: bare `id` in the inventory must match the
+    # builtin exactly, not every accessor method named .id()
+    findings = fire_project(WireNameDeterminismPass(), **{"w.py": """
+        def sync(peer, job, buf):
+            peer.all_reduce(buf, name=f"slot:{job.id()}")
+
+        def bad(peer, buf):
+            peer.all_reduce(buf, name=f"slot:{id(buf)}")
+    """})
+    assert len(findings) == 1
+    assert "'id'" in findings[0].message or " id" in findings[0].message
+
+
+def test_wire_name_ignores_one_sided_store_ops():
+    # save/request legitimately key by rank (per-peer model slots)
+    findings = fire_project(WireNameDeterminismPass(), **{"w.py": """
+        def publish(peer, buf):
+            peer.save(f"model:{peer.rank}", buf)
+            peer.request((peer.rank + 1) % peer.size,
+                         f"model:{peer.rank}", buf)
+    """})
+    assert findings == []
+
+
+# -- kfverify: collective-order ----------------------------------------------
+
+
+def _order_pass(path="w.py", qual=None):
+    return CollectiveOrderPass(entries={"fixture": (path, qual)})
+
+
+def test_collective_order_fires_on_rank_gated_collective():
+    findings = fire_project(_order_pass(qual="step"), **{"w.py": """
+        def step(peer, buf):
+            if peer.rank == 0:
+                peer.broadcast(buf, name="m")
+            return buf
+    """})
+    assert len(findings) == 1
+    assert "rank-dependent test" in findings[0].message
+
+
+def test_collective_order_fires_through_call_chain():
+    # the divergent branch calls a HELPER whose callee runs the
+    # collective — the finding lands at the gated call site
+    findings = fire_project(_order_pass(qual="step"), **{"w.py": """
+        def _sync(peer, buf):
+            peer.all_reduce(buf, name="g")
+
+        def helper(peer, buf):
+            _sync(peer, buf)
+
+        def step(peer, buf):
+            if peer.local_rank == 0:
+                helper(peer, buf)
+    """})
+    assert findings and "rank-dependent test" in findings[0].message
+
+
+def test_collective_order_fires_on_clock_bounded_loop():
+    findings = fire_project(_order_pass(qual="recover"), **{"w.py": """
+        import time
+
+        def recover(peer, deadline):
+            while time.monotonic() < deadline:
+                peer.barrier()
+    """})
+    assert len(findings) == 1
+    assert "clock-bounded loop" in findings[0].message
+
+
+def test_collective_order_quiet_on_schedule_loops():
+    findings = fire_project(_order_pass(qual="step"), **{"w.py": """
+        def step(peer, chunks):
+            for ci, spans in enumerate(chunks):
+                peer.broadcast_inplace(spans, name=f"c{ci}")
+            for k in range(8):
+                peer.all_reduce(k, name=f"b{k}")
+            peer.barrier()
+    """})
+    assert findings == []
+
+
+def test_collective_order_fails_loudly_on_renamed_entry():
+    # a present file missing the named entry function is a rename
+    # regression — silently skipping it would un-gate the path
+    findings = fire_project(_order_pass(qual="no_such_fn"), **{"w.py": """
+        def step(peer, buf):
+            peer.barrier()
+    """})
+    assert len(findings) == 1
+    assert "no longer exists" in findings[0].message
+
+
+def test_wire_name_fires_on_positional_name_argument():
+    # review regression: a rank-derived name passed POSITIONALLY
+    # through a resolvable signature must be judged like a name= kwarg
+    findings = fire_project(WireNameDeterminismPass(), **{"p.py": """
+        class Peer:
+            def all_reduce(self, x, op="sum", name=""):
+                return x
+    """, "u.py": """
+        def sync(peer, buf):
+            peer.all_reduce(buf, "sum", f"g:{peer.rank}")
+    """})
+    assert len(findings) == 1
+    assert findings[0].path == "u.py"
+    assert "rank" in findings[0].message
+
+
+def test_stale_suppression_flags_dead_half_of_multi_pass_disable(
+        tmp_path):
+    p = tmp_path / "half.py"
+    p.write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            # kflint: disable=retry-discipline,trace-purity
+            except Exception:
+                pass
+    """))
+    findings = run_paths([str(tmp_path)])
+    stale = [f for f in findings if f.pass_name == "stale-suppression"]
+    assert len(stale) == 1
+    # only the dead half is flagged; the live retry half still vouches
+    assert "trace-purity" in stale[0].message
+    assert "retry-discipline" not in stale[0].message
+
+
+def test_collective_order_extracts_sequences():
+    p = _order_pass(qual="step")
+    fire_project(p, **{"w.py": """
+        def _inner(peer, buf):
+            peer.all_reduce(buf, name="g")
+
+        def step(peer, buf):
+            peer.consensus(buf, name="kf::resize")
+            _inner(peer, buf)
+            peer.barrier()
+    """})
+    ops = [s.op for s in p.sequences["fixture"]]
+    assert ops == ["consensus", "all_reduce", "barrier"]
+
+
+# -- kfverify: schedule-purity -----------------------------------------------
+
+
+def test_schedule_purity_fires_on_env_and_value_reads():
+    findings = fire_project(SchedulePurityPass(), **{"s.py": """
+        import os
+
+        import numpy as np
+
+        def chunk_bytes_from_env():
+            return int(os.getenv("CHUNK_MB", "4")) * 2**20
+
+        def biggest(grads):
+            return float(np.max(grads[0]))
+
+        def stream(tree, grads):
+            return chunk_schedule(tree, chunk_bytes_from_env())
+
+        def stream2(tree, grads):
+            return bucket_schedule(tree, biggest(grads))
+    """})
+    msgs = " ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "env read" in msgs and "tensor-value read" in msgs
+
+
+def test_schedule_purity_reports_env_subscript_once():
+    # review regression: os.environ["X"] is one hazard, not two
+    # findings (the Subscript and its Attribute base both matched)
+    findings = fire_project(SchedulePurityPass(), **{"s.py": """
+        import os
+
+        def from_env():
+            return int(os.environ["KF_CHUNK"]) * 2**20
+
+        def stream(tree):
+            return chunk_schedule(tree, from_env())
+    """})
+    assert len(findings) == 1
+    assert "os.environ[...]" in findings[0].message
+
+
+def test_schedule_purity_reports_env_get_once():
+    # review regression: os.environ.get() matched both the Call branch
+    # and its inner os.environ Attribute — one hazard, one finding
+    findings = fire_project(SchedulePurityPass(), **{"s.py": """
+        import os
+
+        def from_env():
+            return int(os.environ.get("KF_CHUNK", "4")) * 2**20
+
+        def stream(tree):
+            return chunk_schedule(tree, from_env())
+    """})
+    assert len(findings) == 1
+    assert "os.environ.get()" in findings[0].message
+
+
+def test_schedule_purity_quiet_on_init_and_shapes():
+    findings = fire_project(SchedulePurityPass(), **{"s.py": """
+        import os
+
+        import numpy as np
+
+        def from_env():
+            return int(os.getenv("CHUNK_MB", "4")) * 2**20
+
+        def shape_bytes(tree):
+            return int(np.prod(np.shape(tree[0])))
+
+        class Pipe:
+            def __init__(self, tree):
+                # construction-time env read: uniform for the object's
+                # lifetime, exactly like GradBucketPipeline
+                self._schedule = bucket_schedule(tree, from_env())
+
+        def stream(tree):
+            return chunk_schedule(tree, shape_bytes(tree))
+    """})
+    assert findings == []
+
+
+# -- kfverify: lock-order ----------------------------------------------------
+
+
+def test_lock_order_fires_on_ab_ba_cycle():
+    findings = fire_project(LockOrderPass(), **{"l.py": """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _b:
+                with _a:
+                    pass
+    """})
+    assert len(findings) == 1
+    assert "lock-order cycle" in findings[0].message
+    assert "_a" in findings[0].message and "_b" in findings[0].message
+
+
+def test_lock_order_fires_across_modules_via_calls():
+    findings = fire_project(LockOrderPass(), **{"m1.py": """
+        import threading
+
+        import m2
+
+        _a = threading.Lock()
+
+        def outer():
+            with _a:
+                m2.inner()
+    """, "m2.py": """
+        import threading
+
+        import m1
+
+        _b = threading.Lock()
+
+        def inner():
+            with _b:
+                pass
+
+        def reverse():
+            with _b:
+                m1.outer()
+    """})
+    cycles = [f for f in findings if "lock-order cycle" in f.message]
+    assert len(cycles) == 1
+    # the fixture also contains a real secondary hazard the pass must
+    # see: reverse -> outer -> inner re-acquires _b while held
+    assert any("re-acquisition" in f.message for f in findings)
+
+
+def test_lock_order_fires_on_self_deadlock():
+    findings = fire_project(LockOrderPass(), **{"l.py": """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def tick(self):
+                with self._mu:
+                    self.flush()
+
+            def flush(self):
+                with self._mu:
+                    pass
+    """})
+    assert len(findings) == 1
+    assert "re-acquisition" in findings[0].message
+
+
+def test_lock_order_quiet_on_consistent_order_and_rlock():
+    findings = fire_project(LockOrderPass(), **{"l.py": """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+        _r = threading.RLock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _a:
+                with _b:
+                    pass
+
+        def reent():
+            with _r:
+                again()
+
+        def again():
+            with _r:
+                pass
+
+        def submitter(pool):
+            with _b:
+                pool.submit(one)  # worker runs WITHOUT _b: no edge
+    """})
+    assert findings == []
+
+
+# -- kfverify: the small-scope explorer --------------------------------------
+
+
+def test_explorer_extracts_template_from_real_pipeline():
+    slots = explore._default_slots()
+    kinds = [k for k, _ in slots]
+    assert explore.EPOCH_F in kinds
+    assert explore.STEP_F in kinds
+    assert explore.BUCKET_F in kinds
+
+
+def test_explorer_reproduces_pr5_divergence_trace():
+    slots = explore._default_slots()
+    bad = explore.explore_epoch_switch("local-counter", slots)
+    assert bad, "the PR 5 binding must diverge"
+    trace = bad[0].trace()
+    # two ranks offering DIFFERENT names for the same bucket slot
+    offers = set(bad[0].offers.values())
+    assert len(offers) == 2
+    assert all(o.endswith(":b0") for o in offers)
+    assert "divergence" in trace and "offers" in trace
+
+
+def test_explorer_agreed_binding_completes_every_interleaving():
+    slots = explore._default_slots()
+    assert explore.explore_epoch_switch("agreed", slots) == []
+
+
+def test_explorer_lockstep_reports_exhausted_rank():
+    d = explore.check_lockstep({0: ["a", "b"], 1: ["a"]})
+    assert d is not None and d.at == 1
+    assert d.offers[1] is None  # rank 1 exhausted: rank 0 hangs
+
+
+# -- lock-discipline: closure-local guarded state ----------------------------
+
+
+def test_lock_closure_fires_on_unlocked_nested_write():
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        def pipeline(n):
+            mu = threading.Lock()
+            flats = [None] * n  # kf: guarded_by(mu)
+
+            def fetch(i):
+                flats[i] = i  # missing lock!
+
+            return fetch
+    """)
+    assert len(findings) == 1
+    assert "flats" in findings[0].message
+
+
+def test_lock_closure_quiet_on_locked_defining_and_shadow():
+    findings = fire(LockDisciplinePass(), """
+        import threading
+
+        def pipeline(n):
+            mu = threading.Lock()
+            flats = [None] * n  # kf: guarded_by(mu)
+            flats[0] = 0        # defining scope: pre-thread, exempt
+
+            def fetch(i):
+                with mu:
+                    flats[i] = i
+
+            def shadow(i):
+                flats = []      # local twin: not the shared closure
+                flats.append(i)
+
+            return fetch
+    """)
+    assert findings == []
+
+
+# -- stale-suppression audit + CLI JSON/baseline -----------------------------
+
+
+def test_stale_suppression_flagged(tmp_path):
+    live = tmp_path / "live.py"
+    live.write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            # kflint: disable=retry-discipline
+            except Exception:
+                pass
+    """))
+    stale = tmp_path / "stale.py"
+    stale.write_text(textwrap.dedent("""
+        def f():
+            # kflint: disable=retry-discipline
+            return 1
+
+        def g():
+            return 2  # kflint: disable=no-such-pass
+    """))
+    findings = run_paths([str(tmp_path)])
+    stale_f = [f for f in findings
+               if f.pass_name == "stale-suppression"]
+    assert len(stale_f) == 2
+    msgs = " ".join(f.message for f in stale_f)
+    assert "no longer matches" in msgs
+    assert "unknown pass" in msgs
+    assert all(f.path == str(stale) for f in stale_f)
+
+
+def test_disable_inside_string_literal_is_inert(tmp_path):
+    # a STRING mentioning the marker must neither suppress findings on
+    # its line nor register as a stale suppression
+    p = tmp_path / "s.py"
+    p.write_text('MSG = "justify with # kflint: disable=retry-'
+                 'discipline"\n')
+    findings = run_paths([str(p)])
+    assert [f for f in findings
+            if f.pass_name == "stale-suppression"] == []
+
+
+def test_cli_json_ids_are_stable(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    try:\n        g()\n    except:\n"
+                   "        pass\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.analysis", str(bad),
+         "--select", "retry-discipline", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["count"] == 1
+    fid = doc["findings"][0]["id"]
+    pass_name, path, line, digest = fid.rsplit(":", 3)
+    assert pass_name == "retry-discipline"
+    assert path.endswith("bad.py") and line == "4"
+    assert len(digest) == 8
+    # stable: a second run yields the identical id
+    r2 = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.analysis", str(bad),
+         "--select", "retry-discipline", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert json.loads(r2.stdout)["findings"][0]["id"] == fid
+
+
+def test_cli_baseline_gates_on_new_findings_only(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    try:\n        g()\n    except:\n"
+                   "        pass\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # full-suite runs: the baseline is a full-run artifact (--select
+    # with --baseline is rejected, see the mutual-exclusion test)
+    run = [sys.executable, "-m", "kungfu_tpu.analysis", str(bad)]
+    r = subprocess.run(run + ["--json"], cwd=REPO, capture_output=True,
+                       text=True, timeout=120, env=env)
+    fid = json.loads(r.stdout)["findings"][0]["id"]
+    baseline = tmp_path / "baseline.json"
+    # the committed-debt case: finding in baseline -> exit 0
+    baseline.write_text(json.dumps({"version": 1, "ids": [fid]}))
+    r = subprocess.run(run + ["--baseline", str(baseline)], cwd=REPO,
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    assert "no new findings" in r.stderr
+    # the regression case: empty baseline -> exit 1, NEW reported
+    baseline.write_text(json.dumps({"version": 1, "ids": []}))
+    r = subprocess.run(run + ["--baseline", str(baseline)], cwd=REPO,
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 1
+    assert "NEW finding(s)" in r.stderr
+    # the fixed case: baseline lists a gone finding -> reported, exit 0
+    baseline.write_text(json.dumps({"version": 1,
+                                    "ids": [fid, "gone:x.py:1:deadbeef"]}))
+    r = subprocess.run(run + ["--baseline", str(baseline)], cwd=REPO,
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0
+    assert "1 baseline finding(s) fixed" in r.stderr
+
+
+def test_baseline_diff_survives_line_shifts():
+    # review regression: a pure line shift (import added above a
+    # baselined finding) must not turn committed debt into a NEW gate
+    # failure — but a SECOND instance of the same hazard must
+    from kungfu_tpu.analysis.__main__ import diff_baseline
+
+    new, fixed = diff_baseline(
+        {"retry-discipline:foo.py:121:abcd1234"},
+        {"retry-discipline:foo.py:120:abcd1234"})
+    assert new == set() and fixed == set()
+    new, fixed = diff_baseline(
+        {"retry-discipline:foo.py:121:abcd1234",
+         "retry-discipline:foo.py:300:abcd1234"},
+        {"retry-discipline:foo.py:120:abcd1234"})
+    assert len(new) == 1 and fixed == set()
+    new, fixed = diff_baseline(
+        set(), {"retry-discipline:foo.py:120:abcd1234"})
+    assert new == set()
+    assert fixed == {"retry-discipline:foo.py:120:abcd1234"}
+
+
+def test_cli_select_and_baseline_are_mutually_exclusive(tmp_path):
+    # review regression: a subset run diffed against the full-run
+    # baseline reports every other pass's IDs as "fixed" and invites a
+    # baseline regeneration that breaks the next full run
+    p = tmp_path / "ok.py"
+    p.write_text("x = 1\n")
+    b = tmp_path / "b.json"
+    b.write_text('{"version": 1, "ids": []}')
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.analysis", str(p),
+         "--select", "retry-discipline", "--baseline", str(b)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 2
+    assert "mutually exclusive" in r.stderr
+
+
+def test_cli_errors_on_missing_or_corrupt_baseline(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    run = [sys.executable, "-m", "kungfu_tpu.analysis", str(ok)]
+    r = subprocess.run(run + ["--baseline", str(tmp_path / "no.json")],
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=120, env=env)
+    assert r.returncode == 2  # unreadable baseline must not green CI
+    assert "cannot read baseline" in r.stderr
+    # a truncated/corrupted write (valid JSON, wrong shape) must hit
+    # the same diagnostic, not an uncaught traceback
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("null")
+    r = subprocess.run(run + ["--baseline", str(corrupt)], cwd=REPO,
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2
+    assert "cannot read baseline" in r.stderr
+
+
+def test_stale_audit_skips_single_file_spot_checks():
+    # review regression: the interprocedural passes need the files a
+    # suppression's call chain crosses — a single-file invocation must
+    # not flag the tree's deliberate suppressions as stale
+    findings = run_paths([os.path.join(PKG, "peer.py")])
+    assert [f for f in findings
+            if f.pass_name == "stale-suppression"] == []
+
+
 # -- suppression / plumbing --------------------------------------------------
 
 
@@ -568,7 +1382,9 @@ def test_pass_registry_names_are_unique_and_complete():
     assert len(names) == len(set(names))
     assert set(names) >= {"retry-discipline", "axis-consistency",
                           "trace-purity", "vmem-budget",
-                          "lock-discipline", "unused-imports"}
+                          "lock-discipline", "unused-imports",
+                          "wire-name-determinism", "collective-order",
+                          "schedule-purity", "lock-order"}
 
 
 # -- the point: the tree itself lints clean ----------------------------------
