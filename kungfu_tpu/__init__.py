@@ -38,6 +38,13 @@ def __getattr__(name):
         attr = getattr(checkpoint, name)
         globals()[name] = attr  # cache: next lookup is a dict hit
         return attr
+    if name in ("AsyncShardedCheckpointer", "save_sharded",
+                "restore_sharded"):
+        from . import checkpoint_async
+
+        attr = getattr(checkpoint_async, name)
+        globals()[name] = attr
+        return attr
     if name == "GradBucketPipeline":
         from .grad_pipeline import GradBucketPipeline
 

@@ -1,13 +1,16 @@
 """schedule-purity: schedule inputs must be shape-only functions.
 
-`chunk_schedule` / `bucket_schedule` are the determinism anchor of the
-streaming and gradient pipelines: every rank derives the identical
-chunk/bucket layout FROM ITS OWN pytree because the schedule reads
-shapes and dtypes only. Anything value-dependent smuggled into that
-derivation — a tensor-value read (two ranks hold different gradient
-values), an env read at call time (two ranks may be configured apart),
-a clock or RNG — silently yields per-rank schedules, which means
-per-rank wire sequences, which means a hang with no error message.
+`chunk_schedule` / `bucket_schedule` / `shard_schedule` are the
+determinism anchor of the streaming, gradient and sharded-checkpoint
+pipelines: every rank derives the identical chunk/bucket/shard layout
+FROM ITS OWN pytree because the schedule reads shapes and dtypes only.
+Anything value-dependent smuggled into that derivation — a
+tensor-value read (two ranks hold different gradient values), an env
+read at call time (two ranks may be configured apart), a clock or RNG
+— silently yields per-rank schedules, which means per-rank wire
+sequences (a hang with no error message) or, for the checkpoint shard
+scheduler, per-rank owner maps whose shards overlap or leave byte
+gaps — a checkpoint that LOOKS complete but cannot restore.
 
 The pass finds every schedule call site and checks the functions
 feeding its arguments (the argument expressions' calls plus the
@@ -42,7 +45,8 @@ from .project import (CLOCK_CALLS, ENV_CALLS, RNG_CALLS, FuncInfo,
 
 NAME = "schedule-purity"
 
-SCHEDULE_FUNCS = {"chunk_schedule", "bucket_schedule"}
+SCHEDULE_FUNCS = {"chunk_schedule", "bucket_schedule",
+                  "shard_schedule"}
 
 _VALUE_METHODS = {"item", "tolist", "any", "all", "nonzero", "argmax",
                   "argmin"}

@@ -296,6 +296,69 @@ def corrupt_file(path: str, nbytes: int = 8,
     return offsets
 
 
+#: the three ways a sharded checkpoint generation can rot on disk
+#: (kungfu_tpu/checkpoint_async.py layout); each must make restore
+#: fail loudly or fall back to the previous COMPLETE generation —
+#: never silently load a mix (tests/test_chaos.py holds it to that)
+SHARDED_CORRUPTIONS = ("torn_shard", "missing_shard",
+                      "mismatch_manifest")
+
+
+def corrupt_sharded_generation(gen_dir: str, mode: str,
+                               seed: Optional[int] = None) -> str:
+    """Deterministically damage one sharded checkpoint generation.
+
+    ``torn_shard`` truncates a schedule-seeded shard file to a seeded
+    fraction (the power-loss-mid-write shape); ``missing_shard``
+    deletes one (a lost disk / partial copy); ``mismatch_manifest``
+    rewrites one rank's manifest piece with a different step (a stale
+    piece surviving from an older attempt). The victim file and the
+    torn length derive from the seed alone, so a failing chaos test
+    replays byte-identically. Returns the damaged path."""
+    import glob as _glob
+
+    if mode not in SHARDED_CORRUPTIONS:
+        raise ValueError(f"unknown sharded corruption {mode!r} "
+                         f"(known: {SHARDED_CORRUPTIONS})")
+    if seed is None:
+        sched = active()
+        seed = sched.seed if sched is not None else 0
+    rng = random.Random(seed)
+    if mode == "mismatch_manifest":
+        victims = sorted(_glob.glob(os.path.join(gen_dir,
+                                                 "manifest-r*.json")))
+    else:
+        victims = sorted(_glob.glob(os.path.join(gen_dir,
+                                                 "shard-r*.bin")))
+        if mode == "torn_shard":
+            # an incremental generation legitimately leaves 0-byte
+            # shards (a rank whose owned leaves were all unchanged);
+            # tearing one would be a silent no-op that still FIRES —
+            # a fault the schedule claims but never injected
+            victims = [v for v in victims if os.path.getsize(v) > 0]
+    if not victims:
+        raise FileNotFoundError(
+            f"no {mode} victim files under {gen_dir}")
+    path = victims[rng.randrange(len(victims))]
+    if mode == "torn_shard":
+        size = os.path.getsize(path)
+        keep = rng.randrange(size)  # strictly shorter
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+        _fire("torn_shard", path=path, kept=keep, seed=seed)
+    elif mode == "missing_shard":
+        os.unlink(path)
+        _fire("missing_shard", path=path, seed=seed)
+    else:
+        with open(path) as f:
+            piece = json.load(f)
+        piece["step"] = int(piece.get("step", 0)) + 1  # stale piece
+        with open(path, "w") as f:
+            json.dump(piece, f)
+        _fire("mismatch_manifest", path=path, seed=seed)
+    return path
+
+
 # -- netns fault fabric -------------------------------------------------------
 
 _NETNS_CAPABLE: Optional[bool] = None

@@ -23,6 +23,17 @@ import numpy as np
 _BF16_SUFFIX = "::bf16"  # np.savez cannot store bfloat16 natively
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a completed rename survives power loss —
+    the shared half of every durable-write sequence here and in
+    checkpoint_async (one copy, so the two tiers cannot drift)."""
+    fd = os.open(path or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def _path_str(path) -> str:
     out = []
     for p in path:
@@ -78,7 +89,15 @@ def save_checkpoint(path: str, tree, step: Optional[int] = None) -> str:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
+        # durability, not just atomicity: os.replace alone protects
+        # against torn files, but without fsync a power loss can drop
+        # the data blocks (or the rename itself) after save_checkpoint
+        # returned success — flush the file, then persist the rename
+        # by fsyncing the containing directory
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)  # atomic: a crash never leaves a torn file
+    fsync_dir(d)
     return path
 
 

@@ -1,0 +1,1093 @@
+"""Zero-stall elastic checkpointing: async sharded incremental saves.
+
+`checkpoint.py`'s npz dump is the restart-from-zero backstop, but it is
+synchronous and whole-tree: rank 0 `device_get`s and serializes every
+byte while all peers stall at the next collective, so durable
+checkpoints are either rare (big recovery-loss window) or expensive (a
+fixed % of every step burned). This module is the checkpoint tier the
+fault-tolerance story needs — the last rung of the recovery state
+machine (docs/fault_tolerance.md): when the whole cluster dies and the
+live-resync path has nobody left to resync from, a relaunched cluster
+(of ANY size) restores the latest complete generation instead of losing
+all state.
+
+Three properties, each riding machinery the elastic runtime already
+proved:
+
+- **Sharded.** Each peer writes only its shard of the param/opt tree.
+  Shard assignment is `ops.collective.shard_schedule` — the same
+  deterministic `chunk_schedule` spans the elastic streaming resync
+  uses, round-robined over ranks — and bytes are taken through
+  `leaf_byte_views`, so a peer's shard file is a sequence of zero-copy
+  span writes with no model-sized staging buffer. Because the schedule
+  is a pure function of shapes/dtypes, the save path needs NO
+  collectives at all: every rank derives the identical owner map from
+  its own replica, and the filesystem is the rendezvous (per-rank
+  manifest pieces are the commit markers; a generation is complete iff
+  every rank's piece exists and agrees).
+- **Asynchronous.** `AsyncShardedCheckpointer.save()` snapshots the
+  tree and returns; hashing, span writes, fsync and the manifest commit
+  run on an executor thread overlapped with the next training steps.
+  The snapshot itself is double-buffered and nearly free: jax leaves
+  are immutable, so the training thread only *captures references* and
+  the writer thread pays the D2H (`np.asarray`) per leaf — JAX async
+  dispatch blocks only until that leaf's producing computation is done,
+  which the next steps' dispatch hides. Only writeable numpy leaves
+  (which a trainer may mutate in place) are copied eagerly, and only
+  the spans this rank owns. A bounded number of snapshots may be in
+  flight (`max_pending`, default 2 — the double buffer); a third
+  `save()` blocks until the oldest write lands, which is the
+  backpressure keeping a slow disk from hoarding host memory.
+- **Incremental.** A per-leaf content hash (blake2b) skips leaves
+  unchanged since the previous generation; tiny leaves (opt-state
+  `step`, scalars — `ALWAYS_WRITE_BYTES`) are always written. The
+  manifest records which generation owns each leaf's bytes, so a
+  generation is a delta chain whose referenced ancestors are retained
+  by GC until unreferenced. Replica divergence cannot corrupt the
+  chain: two ranks sharing spans of one leaf both record its hash, and
+  the manifest merge fails loudly if they disagree.
+
+**Restore re-shards.** A cluster of a *different* np than the save
+reads the manifest, derives a restore-side `shard_schedule` for its own
+size, has each peer read exactly its spans from the owning generations'
+shard files, and exchanges chunks over DCN with the same pipelined
+in-place broadcasts the elastic resync uses (`broadcast_inplace`,
+per-chunk roots). Every leaf is then verified against its manifest
+hash before the tree is returned — a torn shard, a missing shard or a
+mismatched manifest makes the generation fail loudly and restore falls
+back to the previous *complete* generation; a mixed restore is
+impossible by construction. `GradBucketPipeline` error-feedback
+residuals are PER-RANK state (docs/grad_pipeline.md): each rank writes
+its own `residual-r{rank}.npz` sidecar, restore rank r adopts save
+rank r's residuals, and ranks beyond the save size start from zero —
+exactly the survivor/joiner semantics of an elastic resize.
+
+On-disk layout (one directory per generation)::
+
+    <dir>/gen-00000007/
+        shard-r0.bin       rank 0's spans of this generation's delta
+        shard-r1.bin       ...
+        residual-r0.npz    optional per-rank EF residual state
+        manifest-r0.json   per-rank commit marker, written LAST
+        manifest-r1.json   (atomic + fsynced; agreement checked on read)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from hashlib import blake2b
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .checkpoint import _path_str, fsync_dir as _fsync_dir
+from .env import env_float
+from .ops.collective import shard_schedule
+
+FORMAT = "kf-sharded-ckpt-v1"
+GEN_PREFIX = "gen-"
+#: default shard chunk size (MiB) — the same granularity trade-off as
+#: the elastic streaming path; override with KF_CKPT_CHUNK_MB.
+DEFAULT_CHUNK_MB = 4.0
+#: leaves at or below this byte size are written every generation
+#: regardless of hash — opt-state step counters and scalars change
+#: every step anyway, and always-writing them keeps the newest
+#: generation self-describing for the fast-moving state.
+ALWAYS_WRITE_BYTES = 512
+
+
+class CheckpointError(RuntimeError):
+    """A generation could not be saved or restored."""
+
+
+class CheckpointCorrupt(CheckpointError):
+    """A generation exists but its bytes cannot be trusted: torn or
+    missing shard, mismatched manifest pieces, or a leaf whose content
+    hash disagrees with its manifest entry."""
+
+
+def _gen_dir(directory: str, gen: int) -> str:
+    return os.path.join(directory, f"{GEN_PREFIX}{gen:08d}")
+
+
+def _manifest_path(gen_dir: str, rank: int) -> str:
+    return os.path.join(gen_dir, f"manifest-r{rank}.json")
+
+
+def _shard_path(gen_dir: str, rank: int) -> str:
+    return os.path.join(gen_dir, f"shard-r{rank}.bin")
+
+
+def _residual_path(gen_dir: str, rank: int) -> str:
+    return os.path.join(gen_dir, f"residual-r{rank}.npz")
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """Write-fsync-rename-fsync: after this returns, a power loss can
+    not lose the file or leave a torn one at `path`."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def _leaf_hash(view: np.ndarray) -> str:
+    return blake2b(view, digest_size=16).hexdigest()
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class _Spec:
+    """shape/dtype stand-in leaf for schedule recomputation at restore
+    time (np.shape/np.dtype read the attributes; no allocation)."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+
+def tree_spec(tree) -> Tuple[List[str], List[Tuple], List[str], Any]:
+    """(keys, shapes, dtype names, treedef) of a pytree in leaf order.
+
+    Keys are the flat tree paths (`checkpoint._path_str`); dtypes come
+    from leaf metadata without forcing a device->host transfer."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    keys, shapes, dtypes = [], [], []
+    for path, leaf in flat:
+        keys.append(_path_str(path))
+        dt = getattr(leaf, "dtype", None)
+        if dt is None:
+            a = np.asarray(leaf)
+            shapes.append(tuple(a.shape))
+            dtypes.append(str(a.dtype))
+        else:
+            shapes.append(tuple(np.shape(leaf)))
+            dtypes.append(str(np.dtype(dt)))
+    if len(set(keys)) != len(keys):
+        raise ValueError("duplicate flat keys in checkpoint tree")
+    return keys, shapes, dtypes, treedef
+
+
+def ckpt_chunk_bytes(chunk_mb: Optional[float] = None) -> int:
+    """Resolve the shard chunk size in bytes: explicit argument, else
+    KF_CKPT_CHUNK_MB (validated at parse time), else
+    `DEFAULT_CHUNK_MB`."""
+    if chunk_mb is None:
+        chunk_mb = env_float("KF_CKPT_CHUNK_MB", DEFAULT_CHUNK_MB)
+    if chunk_mb <= 0:
+        raise ValueError(f"checkpoint chunk size must be positive: "
+                         f"{chunk_mb} MiB")
+    return max(1, int(chunk_mb * 2**20))
+
+
+# -- manifests ---------------------------------------------------------------
+
+
+class Manifest:
+    """The merged, cross-checked view of one COMPLETE generation."""
+
+    def __init__(self, directory: str, gen: int, step: int, nprocs: int,
+                 chunk_bytes: int, keys: List[str],
+                 shapes: List[Tuple], dtypes: List[str],
+                 entries: Dict[str, Tuple[str, int]],
+                 written_by_rank: List[List[str]], meta: Dict):
+        self.directory = directory
+        self.gen = gen
+        self.step = step
+        self.nprocs = nprocs
+        self.chunk_bytes = chunk_bytes
+        self.keys = keys
+        self.shapes = shapes
+        self.dtypes = dtypes
+        #: key -> (content hash, owning generation)
+        self.entries = entries
+        self.written_by_rank = written_by_rank
+        self.meta = meta
+
+    @property
+    def gen_dir(self) -> str:
+        return _gen_dir(self.directory, self.gen)
+
+
+def list_generations(directory: str) -> List[int]:
+    """All generation numbers present on disk (complete or not), desc."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return out
+    for n in names:
+        if n.startswith(GEN_PREFIX):
+            try:
+                out.append(int(n[len(GEN_PREFIX):]))
+            except ValueError:
+                continue
+    return sorted(out, reverse=True)
+
+
+def next_generation(directory: str) -> int:
+    gens = list_generations(directory)
+    return (gens[0] + 1) if gens else 1
+
+
+def load_manifest(directory: str, gen: int) -> Manifest:
+    """Load and merge every rank's manifest piece of one generation.
+
+    Raises `CheckpointCorrupt` unless the generation is COMPLETE and
+    internally consistent: every rank's piece present and agreeing on
+    the shared fields, every shard file present at its recorded size,
+    and no two ranks disagreeing on a shared leaf's hash (which would
+    mean the save-time replicas had diverged)."""
+    gen_dir = _gen_dir(directory, gen)
+    try:
+        with open(_manifest_path(gen_dir, 0)) as f:
+            head = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(
+            f"gen {gen}: rank-0 manifest unreadable: {e}") from e
+    if head.get("format") != FORMAT:
+        raise CheckpointCorrupt(
+            f"gen {gen}: unknown format {head.get('format')!r}")
+    nprocs = int(head["nprocs"])
+    shared = ("format", "gen", "step", "nprocs", "chunk_bytes", "keys",
+              "shapes", "dtypes", "meta")
+    entries: Dict[str, Tuple[str, int]] = {}
+    written_by_rank: List[List[str]] = []
+    for r in range(nprocs):
+        if r == 0:
+            piece = head
+        else:
+            try:
+                with open(_manifest_path(gen_dir, r)) as f:
+                    piece = json.load(f)
+            except (OSError, ValueError) as e:
+                raise CheckpointCorrupt(
+                    f"gen {gen}: manifest piece for rank {r} "
+                    f"missing/unreadable: {e}") from e
+            for fld in shared:
+                if piece.get(fld) != head.get(fld):
+                    raise CheckpointCorrupt(
+                        f"gen {gen}: manifest pieces disagree on "
+                        f"{fld!r} (rank 0 vs rank {r}) — refusing a "
+                        "mixed restore")
+        for key, ent in piece["leaves"].items():
+            have = entries.get(key)
+            want = (ent["hash"], int(ent["gen"]))
+            if have is not None and have != want:
+                raise CheckpointCorrupt(
+                    f"gen {gen}: ranks disagree on leaf {key!r} "
+                    "(save-time replica divergence?) — refusing a "
+                    "mixed restore")
+            entries[key] = want
+        written_by_rank.append(list(piece["written"]))
+        shard = _shard_path(gen_dir, r)
+        try:
+            size = os.path.getsize(shard)
+        except OSError as e:
+            raise CheckpointCorrupt(
+                f"gen {gen}: shard file for rank {r} missing: {e}"
+            ) from e
+        if size != int(piece["shard_bytes"]):
+            raise CheckpointCorrupt(
+                f"gen {gen}: torn shard for rank {r}: {size} bytes on "
+                f"disk, manifest says {piece['shard_bytes']}")
+    missing = [k for k in head["keys"] if k not in entries]
+    if missing:
+        raise CheckpointCorrupt(
+            f"gen {gen}: no rank owns leaves {missing[:3]}...")
+    return Manifest(
+        directory=directory, gen=gen, step=int(head["step"]),
+        nprocs=nprocs, chunk_bytes=int(head["chunk_bytes"]),
+        keys=list(head["keys"]),
+        shapes=[tuple(s) for s in head["shapes"]],
+        dtypes=list(head["dtypes"]), entries=entries,
+        written_by_rank=written_by_rank, meta=dict(head.get("meta", {})))
+
+
+def complete_generations(directory: str) -> List[int]:
+    """Generations that pass the completeness check, newest first.
+    Incomplete/corrupt ones are skipped silently here — restore warns
+    loudly when it has to FALL BACK past one."""
+    out = []
+    for g in list_generations(directory):
+        try:
+            load_manifest(directory, g)
+        except CheckpointError:
+            continue
+        out.append(g)
+    return out
+
+
+def latest_manifest(directory: str) -> Optional[Manifest]:
+    for g in list_generations(directory):
+        try:
+            return load_manifest(directory, g)
+        except CheckpointError:
+            continue
+    return None
+
+
+# -- save --------------------------------------------------------------------
+
+
+def _host_view(leaf) -> np.ndarray:
+    """Contiguous 1-D uint8 view of a leaf's host bytes (the writer-
+    thread D2H for jax leaves; zero-copy for contiguous numpy)."""
+    a = np.ascontiguousarray(np.asarray(leaf))
+    return a.reshape(-1).view(np.uint8)
+
+
+def write_generation(directory: str, gen: int, leaves: List,
+                     keys: List[str], shapes: List[Tuple],
+                     dtypes: List[str], *, step: int, rank: int,
+                     nprocs: int, chunk_bytes: int,
+                     incremental: bool = True,
+                     prev_hashes: Optional[Dict[str, Tuple[str, int]]]
+                     = None,
+                     known_hashes: Optional[Dict[int, str]] = None,
+                     meta: Optional[Dict] = None,
+                     residual: Optional[Dict] = None) -> Dict:
+    """Write THIS rank's shard + manifest piece of one generation.
+
+    `leaves` may hold None at indices this rank owns no spans of (the
+    snapshot only captures owned leaves). Pure filesystem protocol —
+    no collectives; the manifest piece is this rank's commit marker
+    and is written (atomically, fsynced) only after the shard and the
+    residual sidecar are durable. `known_hashes` (leaf index -> hash)
+    lets the caller vouch for leaves whose bytes provably did not
+    change since the previous generation (the async front end's
+    identity shortcut) — those leaves skip the hash pass AND the D2H
+    entirely unless the always-write rule forces them out. Returns
+    timing/volume info."""
+    t0 = time.perf_counter()
+    gen_dir = _gen_dir(directory, gen)
+    os.makedirs(gen_dir, exist_ok=True)
+    schedule = shard_schedule(
+        [_Spec(s, _dtype_from_name(d)) for s, d in zip(shapes, dtypes)],
+        chunk_bytes, nprocs)
+    my_chunks = [spans for owner, spans in schedule if owner == rank]
+    owned = {i for spans in my_chunks for i, _, _ in spans}
+    nbytes = [int(np.prod(s, dtype=np.int64))
+              * _dtype_from_name(d).itemsize
+              for s, d in zip(shapes, dtypes)]
+    # zero-size leaves have no spans and therefore no schedule owner:
+    # EVERY rank records their (trivial) entry so the manifest merge
+    # still covers each leaf
+    zero = {i for i, n in enumerate(nbytes) if n == 0}
+    owned = sorted(owned | zero)
+    views: Dict[int, np.ndarray] = {}
+
+    def view(i: int) -> np.ndarray:
+        v = views.get(i)
+        if v is None:
+            if leaves[i] is None:
+                if i in zero:
+                    v = np.zeros(0, np.uint8)
+                else:
+                    raise CheckpointError(
+                        f"rank {rank} owns spans of leaf "
+                        f"{keys[i]!r} but the snapshot did not "
+                        "capture it")
+            else:
+                v = _host_view(leaves[i])
+            views[i] = v
+        return v
+
+    t_host = time.perf_counter()
+
+    # per-leaf content hashes decide the delta; tiny leaves are always
+    # written. Replicas are bit-identical under S-SGD, so every rank
+    # owning spans of a leaf reaches the same decision from its own
+    # bytes — the manifest merge cross-checks exactly that.
+    entries: Dict[str, Dict] = {}
+    written: List[str] = []
+    prev_hashes = prev_hashes or {}
+    known_hashes = known_hashes or {}
+    for i in owned:
+        h = known_hashes.get(i)
+        if h is None or nbytes[i] <= ALWAYS_WRITE_BYTES:
+            h = _leaf_hash(view(i))
+        prev = prev_hashes.get(keys[i])
+        fresh = (not incremental or prev is None or prev[0] != h
+                 or nbytes[i] <= ALWAYS_WRITE_BYTES)
+        entries[keys[i]] = {
+            "hash": h, "gen": gen if fresh else prev[1]}
+        if fresh:
+            written.append(keys[i])
+    written_set = set(written)
+    t_hash = time.perf_counter()
+
+    shard = _shard_path(gen_dir, rank)
+    tmp = shard + ".tmp"
+    shard_bytes = 0
+    with open(tmp, "wb") as f:
+        for spans in my_chunks:
+            for i, off, nb in spans:
+                if keys[i] in written_set:
+                    f.write(view(i)[off:off + nb])
+                    shard_bytes += nb
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, shard)
+
+    if residual is not None:
+        payload: Dict[str, np.ndarray] = {
+            "compression": np.asarray(residual.get("compression",
+                                                   "none"))}
+        for k, r in enumerate(residual.get("residual", [])):
+            payload[f"res_{k}"] = np.asarray(r)
+        rtmp = _residual_path(gen_dir, rank) + ".tmp"
+        with open(rtmp, "wb") as f:
+            np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(rtmp, _residual_path(gen_dir, rank))
+    t_write = time.perf_counter()
+
+    piece = {
+        "format": FORMAT, "gen": gen, "step": int(step),
+        "nprocs": nprocs, "chunk_bytes": int(chunk_bytes),
+        "keys": keys, "shapes": [list(s) for s in shapes],
+        "dtypes": dtypes, "meta": dict(meta or {}),
+        "rank": rank, "leaves": entries, "written": written,
+        "shard_bytes": shard_bytes,
+        "residual": residual is not None,
+    }
+    _atomic_write(_manifest_path(gen_dir, rank),
+                  json.dumps(piece).encode())
+    t_done = time.perf_counter()
+    return {
+        "piece": piece,  # callers chain deltas without re-parsing it
+        "gen": gen, "rank": rank,
+        "host_ms": (t_host - t0) * 1e3,
+        "hash_ms": (t_hash - t_host) * 1e3,
+        "write_ms": (t_write - t_hash) * 1e3,
+        "commit_ms": (t_done - t_write) * 1e3,
+        "wall_ms": (t_done - t0) * 1e3,
+        "bytes_written": shard_bytes,
+        "leaves_written": len(written),
+        "leaves_skipped": len(owned) - len(written),
+    }
+
+
+def save_sharded(directory: str, tree, *, step: int, rank: int = 0,
+                 nprocs: int = 1, chunk_bytes: Optional[int] = None,
+                 incremental: bool = True, gen: Optional[int] = None,
+                 meta: Optional[Dict] = None,
+                 residual: Optional[Dict] = None) -> int:
+    """Synchronously write this rank's shard of one generation.
+
+    The blocking convenience form (tests, benchmarks, one-shot tools);
+    training loops should use `AsyncShardedCheckpointer`. When saving
+    from several ranks, derive `gen` ONCE (e.g. `next_generation`) and
+    pass the same value to every rank. Returns the generation."""
+    os.makedirs(directory, exist_ok=True)
+    if chunk_bytes is None:
+        chunk_bytes = ckpt_chunk_bytes()
+    if gen is None:
+        gen = next_generation(directory)
+    keys, shapes, dtypes, _ = tree_spec(tree)
+    prev = None
+    if incremental:
+        for g in complete_generations(directory):
+            if g < gen:
+                prev = load_manifest(directory, g)
+                break
+        if prev is not None and (prev.keys != keys
+                                 or prev.shapes != shapes
+                                 or prev.dtypes != dtypes):
+            prev = None  # tree changed spec: restart a full chain
+    write_generation(
+        directory, gen, jax.tree_util.tree_leaves(tree), keys, shapes,
+        dtypes, step=step, rank=rank, nprocs=nprocs,
+        chunk_bytes=chunk_bytes, incremental=incremental,
+        prev_hashes=prev.entries if prev is not None else None,
+        meta=meta, residual=residual)
+    return gen
+
+
+# -- restore -----------------------------------------------------------------
+
+
+def _source_locations(manifest: Manifest, source_gen: int,
+                      nbytes_by_key: Dict[str, int]
+                      ) -> Dict[str, List[Tuple[int, int, int, int]]]:
+    """Replay generation `source_gen`'s write layout: for every leaf
+    whose bytes the CURRENT manifest attributes to `source_gen`, the
+    disk segments ``(leaf_off, nb, shard_rank, file_off)`` covering it.
+
+    Deterministic from the source manifest alone: the save-side
+    schedule is recomputed shape-only and walked in write order."""
+    src = (manifest if source_gen == manifest.gen
+           else load_manifest(manifest.directory, source_gen))
+    if src.keys != manifest.keys or src.shapes != manifest.shapes \
+            or src.dtypes != manifest.dtypes:
+        raise CheckpointCorrupt(
+            f"gen {source_gen}: tree spec drifted from gen "
+            f"{manifest.gen} that references it")
+    specs = [_Spec(s, _dtype_from_name(d))
+             for s, d in zip(src.shapes, src.dtypes)]
+    schedule = shard_schedule(specs, src.chunk_bytes, src.nprocs)
+    written_sets = [set(w) for w in src.written_by_rank]
+    wanted = {k for k, (_, g) in manifest.entries.items()
+              if g == source_gen}
+    file_off = [0] * src.nprocs
+    locs: Dict[str, List[Tuple[int, int, int, int]]] = {}
+    for owner, spans in schedule:
+        for i, off, nb in spans:
+            key = src.keys[i]
+            if key not in written_sets[owner]:
+                continue
+            if key in wanted:
+                locs.setdefault(key, []).append(
+                    (off, nb, owner, file_off[owner]))
+            file_off[owner] += nb
+    for key in wanted:
+        have = sum(nb for _, nb, _, _ in locs.get(key, []))
+        want = nbytes_by_key[key]
+        if have != want:
+            raise CheckpointCorrupt(
+                f"gen {source_gen}: leaf {key!r} bytes incomplete on "
+                f"disk ({have} of {want}) — manifest chain is "
+                "inconsistent")
+    return locs
+
+
+def _read_my_spans(manifest: Manifest, views: List[np.ndarray],
+                   restore_schedule, rank: int) -> int:
+    """Fill this rank's restore spans straight from the owning
+    generations' shard files (seek + readinto the leaf views — no
+    staging buffer). Returns bytes read."""
+    keys = manifest.keys
+    nbytes_by_key = {k: views[i].size for i, k in enumerate(keys)}
+    source_gens = sorted({g for _, g in manifest.entries.values()})
+    locs: Dict[str, List[Tuple[int, int, int, int]]] = {}
+    for g in source_gens:
+        locs.update(_source_locations(manifest, g, nbytes_by_key))
+    gen_of = {k: g for k, (_, g) in manifest.entries.items()}
+    handles: Dict[Tuple[int, int], Any] = {}
+    total = 0
+    try:
+        for owner, spans in restore_schedule:
+            if owner != rank:
+                continue
+            for i, off, nb in spans:
+                key = keys[i]
+                src_gen = gen_of[key]
+                for loff, lnb, srank, foff in locs[key]:
+                    s = max(off, loff)
+                    e = min(off + nb, loff + lnb)
+                    if s >= e:
+                        continue
+                    hk = (src_gen, srank)
+                    f = handles.get(hk)
+                    if f is None:
+                        path = _shard_path(
+                            _gen_dir(manifest.directory, src_gen),
+                            srank)
+                        try:
+                            f = handles[hk] = open(path, "rb")
+                        except OSError as exc:
+                            raise CheckpointCorrupt(
+                                f"gen {src_gen}: shard for rank "
+                                f"{srank} unreadable: {exc}") from exc
+                    f.seek(foff + (s - loff))
+                    mv = memoryview(views[i][s:e])
+                    while mv:
+                        n = f.readinto(mv)
+                        if not n:
+                            raise CheckpointCorrupt(
+                                f"gen {src_gen}: shard for rank "
+                                f"{srank} truncated reading "
+                                f"{key!r}")
+                        mv = mv[n:]
+                    total += e - s
+    finally:
+        for f in handles.values():
+            f.close()
+    return total
+
+
+def _exchange_chunks(peer, views: List[np.ndarray], restore_schedule,
+                     name: str) -> None:
+    """Re-shard over DCN: every restore chunk broadcast in place from
+    its owning rank, pipelined on one executor thread (the elastic
+    streaming pattern — single-span chunks are pure views end to end,
+    the small-leaf tail passes through a bounded scratch)."""
+    rank = peer.rank
+    pending: deque = deque()
+
+    def pop_one():
+        fut, owner, scratch, spans = pending.popleft()
+        fut.result()
+        if owner != rank and scratch is not None:
+            o = 0
+            for i, off, nb in spans:
+                views[i][off:off + nb] = scratch[o:o + nb]
+                o += nb
+
+    ex = ThreadPoolExecutor(max_workers=1,
+                            thread_name_prefix="kf-ckpt-restore")
+    try:
+        for ci, (owner, spans) in enumerate(restore_schedule):
+            if len(spans) == 1:
+                i, off, nb = spans[0]
+                buf, scratch = views[i][off:off + nb], None
+            else:
+                if owner == rank:
+                    scratch = np.concatenate(
+                        [views[i][off:off + nb]
+                         for i, off, nb in spans])
+                else:
+                    scratch = np.empty(sum(s[2] for s in spans),
+                                       np.uint8)
+                buf = scratch
+            pending.append((
+                ex.submit(peer.broadcast_inplace, buf, owner,
+                          f"{name}:c{ci}"),
+                owner, scratch, spans))
+            while pending and pending[0][0].done():
+                pop_one()
+            while len(pending) > 3:
+                pop_one()
+        while pending:
+            pop_one()
+    finally:
+        ex.shutdown(wait=True)
+
+
+def _load_residual(gen_dir: str, rank: int) -> Optional[Dict]:
+    path = _residual_path(gen_dir, rank)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            res = []
+            k = 0
+            while f"res_{k}" in z.files:
+                res.append(z[f"res_{k}"])
+                k += 1
+            return {"compression": str(z["compression"]),
+                    "residual": res}
+    # numpy's zip stack raises module-private error types (zlib.error,
+    # BadZipFile, ValueError); anything here means the sidecar is
+    # unreadable — re-raise as corruption so the caller falls back a
+    # generation rather than training on a garbled residual
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"residual sidecar {path} unreadable: {e}") from e
+
+
+def _attempt_generation(directory: str, gen: int, like, rank: int,
+                        nprocs: int
+                        ) -> Tuple[Manifest, List, List[np.ndarray],
+                                   Any, Optional[Dict]]:
+    """Local (collective-free) half of a restore attempt: manifest
+    load, template validation, host buffers, this rank's disk reads,
+    residual sidecar. Raises CheckpointError on anything untrustworthy
+    — BEFORE any wire op, so a multi-peer restore can agree to fall
+    back without deadlocking."""
+    manifest = load_manifest(directory, gen)
+    keys, shapes, dtypes, treedef = tree_spec(like)
+    if keys != manifest.keys:
+        raise CheckpointError(
+            f"gen {gen}: template tree has different leaves than the "
+            f"checkpoint (e.g. {next(iter(set(keys) ^ set(manifest.keys)), '?')!r})")
+    if shapes != manifest.shapes or dtypes != manifest.dtypes:
+        bad = [k for k, s, d, ms, md in zip(
+            keys, shapes, dtypes, manifest.shapes, manifest.dtypes)
+            if s != ms or d != md]
+        raise CheckpointError(
+            f"gen {gen}: shape/dtype mismatch vs template for "
+            f"{bad[:3]}")
+    host = [np.empty(s, dtype=_dtype_from_name(d))
+            for s, d in zip(shapes, dtypes)]
+    views = [h.reshape(-1).view(np.uint8) for h in host]
+    specs = [_Spec(s, _dtype_from_name(d))
+             for s, d in zip(shapes, dtypes)]
+    restore_schedule = shard_schedule(specs, manifest.chunk_bytes,
+                                      nprocs)
+    _read_my_spans(manifest, views, restore_schedule, rank)
+    residual = _load_residual(manifest.gen_dir, rank)
+    return manifest, host, views, (treedef, restore_schedule), residual
+
+
+def _verify(manifest: Manifest, views: List[np.ndarray]) -> None:
+    bad = [k for k, v in zip(manifest.keys, views)
+           if _leaf_hash(v) != manifest.entries[k][0]]
+    if bad:
+        raise CheckpointCorrupt(
+            f"gen {manifest.gen}: content hash mismatch for "
+            f"{bad[:3]} ({len(bad)} leaves) — torn or corrupted "
+            "shard data")
+
+
+def restore_sharded(directory: str, like, *, peer=None,
+                    gen: Optional[int] = None):
+    """Restore the latest complete generation, re-sharded to the
+    CURRENT cluster.
+
+    `like` is a pytree with the target structure/shapes/dtypes (e.g.
+    fresh-initialized params+opt). With a `peer` of size > 1 every
+    rank reads exactly its spans of the restore-side `shard_schedule`
+    from the owning generations' shard files and the chunks are
+    exchanged as pipelined in-place broadcasts — the save-time np and
+    the restore-time np are independent. Leaves come back as jax
+    arrays where the template leaf was jax, numpy otherwise (the
+    streaming discipline).
+
+    Every leaf is hash-verified against the manifest before anything
+    is returned. A generation that fails ANY check — incomplete
+    manifest set, mismatched pieces, torn/missing shard, hash mismatch
+    — is reported loudly and restore falls back to the previous
+    complete generation (all ranks fall back together: attempts are
+    agreed via a rank-0 pick broadcast plus an ok-vote all-reduce, so
+    no rank can return state from a generation another rank rejected).
+    Raises `CheckpointError` when no generation survives.
+
+    Returns ``(tree, step, meta, residual)`` — `residual` is this
+    rank's `GradBucketPipeline.state()` sidecar or None (ranks beyond
+    the save size, or uncompressed runs, start from zero — the PR 5
+    joiner semantics)."""
+    multi = peer is not None and peer.size > 1
+    rank = peer.rank if peer is not None else 0
+    nprocs = peer.size if peer is not None else 1
+    # walk EVERY generation on disk, newest first: an incomplete or
+    # corrupt one is rejected loudly inside the attempt (so the
+    # operator sees exactly what was skipped), not filtered silently
+    candidates = [gen] if gen is not None \
+        else list_generations(directory)
+    errors: List[str] = []
+    attempt = 0
+    while True:
+        if multi:
+            # rank 0 drives the fallback walk so every rank attempts
+            # the SAME generation (local completeness scans could
+            # transiently disagree under concurrent saves)
+            pick = np.array(
+                [candidates[attempt] if attempt < len(candidates)
+                 else -1], np.int64)
+            pick = peer.broadcast(pick, root=0,
+                                  name=f"kf::ckpt::pick:{attempt}")
+            g = int(pick[0])
+        else:
+            g = candidates[attempt] if attempt < len(candidates) else -1
+        if g < 0:
+            raise CheckpointError(
+                f"no restorable checkpoint generation under "
+                f"{directory!r}"
+                + (f" (rejected: {'; '.join(errors)})" if errors
+                   else " (none complete)"))
+        manifest = host = views = aux = residual = None
+        try:
+            manifest, host, views, aux, residual = \
+                _attempt_generation(directory, g, like, rank, nprocs)
+            ok = 1
+        except CheckpointError as e:
+            errors.append(f"gen {g}: {e}")
+            print(f"[kf-ckpt] restore: generation {g} rejected "
+                  f"({e}); falling back", flush=True)
+            ok = 0
+        if multi:
+            # unanimity vote BEFORE the exchange: a rank that failed
+            # locally must not be waited on in the chunk broadcasts
+            agreed = peer.all_reduce(np.array([ok], np.int64),
+                                     op="min",
+                                     name=f"kf::ckpt::ok:{attempt}")
+            ok = int(agreed[0])
+        if ok:
+            treedef, restore_schedule = aux
+            if multi:
+                _exchange_chunks(peer, views, restore_schedule,
+                                 f"kf::ckpt::restore:g{g}")
+            try:
+                _verify(manifest, views)
+                ok = 1
+            except CheckpointCorrupt as e:
+                errors.append(str(e))
+                print(f"[kf-ckpt] restore: {e}; falling back",
+                      flush=True)
+                ok = 0
+            if multi:
+                agreed = peer.all_reduce(
+                    np.array([ok], np.int64), op="min",
+                    name=f"kf::ckpt::verify:{attempt}")
+                ok = int(agreed[0])
+            if ok:
+                import jax.numpy as jnp
+
+                leaves = jax.tree_util.tree_leaves(like)
+                out = [jnp.asarray(h) if isinstance(l, jax.Array)
+                       else h for l, h in zip(leaves, host)]
+                return (jax.tree_util.tree_unflatten(treedef, out),
+                        manifest.step, manifest.meta, residual)
+        attempt += 1
+
+
+# -- the async front end ------------------------------------------------------
+
+
+class AsyncShardedCheckpointer:
+    """Overlap sharded incremental saves with the training loop.
+
+    ::
+
+        ckpt = AsyncShardedCheckpointer(dir_, peer)
+        ...
+        ckpt.save((params, opt_state), step=elastic.state.step,
+                  residual=pipe.state() if pipe else None)
+        ...
+        ckpt.close()    # drain pending writes
+
+    `save()` returns after capturing a snapshot: jax leaves by
+    reference (immutable — the writer thread pays the per-leaf D2H,
+    which JAX async dispatch hides behind the next steps), writeable
+    numpy leaves this rank owns spans of by copy. Hashing, span
+    writes, fsync and the manifest commit all run on the executor
+    thread. At most `max_pending` snapshots may be in flight (the
+    double buffer); further saves block on the oldest write.
+
+    NOT compatible with buffer donation of the checkpointed arrays
+    (`donate_argnums` over params/opt): a donated jax buffer may be
+    reused before the writer thread reads it — pass `snapshot="copy"`
+    to force eager copies in that case.
+
+    Write errors surface at the NEXT `save()`/`wait()`/`close()`
+    rather than crashing the step that queued them.
+    """
+
+    def __init__(self, directory: str, peer=None, *,
+                 chunk_bytes: Optional[int] = None,
+                 incremental: bool = True, keep: int = 3,
+                 max_pending: int = 2, snapshot: str = "auto"):
+        if snapshot not in ("auto", "copy"):
+            raise ValueError(f"snapshot={snapshot!r} must be "
+                             "'auto' or 'copy'")
+        self.directory = directory
+        self.peer = peer
+        self.rank = peer.rank if peer is not None else 0
+        self.nprocs = peer.size if peer is not None else 1
+        # init-time env read: rank-uniform via the launcher's
+        # CONFIG_VARS forwarding, fixed for the object's lifetime
+        self.chunk_bytes = (ckpt_chunk_bytes() if chunk_bytes is None
+                            else int(chunk_bytes))
+        self.incremental = incremental
+        self.keep = max(1, keep)
+        self.snapshot = snapshot
+        os.makedirs(directory, exist_ok=True)
+        prev = latest_manifest(directory)
+        if prev is not None:
+            self._hashes: Dict[str, Tuple[str, int]] = dict(
+                prev.entries)
+            self._prev_spec: Optional[Tuple] = (
+                list(prev.keys), list(prev.shapes),
+                list(prev.dtypes))
+        else:
+            self._hashes = {}
+            self._prev_spec = None
+        self._schedule = None
+        self._owned: Optional[set] = None
+        # identity shortcut: key -> (id of the leaf object the hash
+        # was computed from, hash). Valid ONLY because _prev_snap
+        # keeps those exact objects alive — a freed object's id could
+        # be recycled onto different bytes. jax arrays only (numpy is
+        # mutable in place, so identity proves nothing there).
+        self._id_hash: Dict[str, Tuple[int, str]] = {}
+        self._prev_snap: Optional[List] = None
+        self._sem = threading.Semaphore(max(1, max_pending))
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kf-ckpt")
+        self._pending: List = []
+        self._keys: Optional[List[str]] = None
+        self._mu = threading.Lock()
+        self._errors: List[BaseException] = []  # kf: guarded_by(_mu)
+        #: timings/volume of the most recent completed write (benign
+        #: racy read: written only on the writer thread)
+        self.last_save_info: Dict = {}
+
+    # -- snapshot (training thread) ------------------------------------------
+
+    def _owned_indices(self, keys, shapes, dtypes) -> set:
+        if self._owned is None or self._keys != keys:
+            specs = [_Spec(s, _dtype_from_name(d))
+                     for s, d in zip(shapes, dtypes)]
+            self._schedule = shard_schedule(specs, self.chunk_bytes,
+                                            self.nprocs)
+            self._owned = {i for owner, spans in self._schedule
+                           if owner == self.rank
+                           for i, _, _ in spans}
+            self._keys = keys
+        return self._owned
+
+    def save(self, tree, step: int, *, meta: Optional[Dict] = None,
+             residual: Optional[Dict] = None,
+             block: bool = False) -> int:
+        """Queue one generation; returns its number immediately (or
+        after the write with `block=True`). Raises any error a
+        PREVIOUS queued write hit.
+
+        The generation number IS `step` (which must be the
+        cluster-agreed training step, >= 1): no local counter exists
+        to drift, so a joiner's fresh checkpointer and the survivors'
+        long-lived ones name the same generation by construction even
+        while earlier generations are still being written in the
+        background on other ranks — the same agreed-step rule the
+        gradient pipeline's wire names follow. Re-saving the SAME
+        step (a recovery redoing the step it lost) overwrites this
+        rank's piece of that generation in place, which converges."""
+        self._raise_pending_errors()
+        if step < 1:
+            raise ValueError(
+                f"save() needs the cluster-agreed step >= 1, got "
+                f"{step} — generation numbers derive from it")
+        keys, shapes, dtypes, _ = tree_spec(tree)
+        spec = (keys, shapes, dtypes)
+        if self._prev_spec is not None and self._prev_spec != spec:
+            # tree changed spec (keys OR shapes OR dtypes) vs the
+            # chain so far: restart a full chain — chaining a reshaped
+            # leaf to old generations would save fine but never
+            # restore (the spec-drift check rejects it)
+            self._hashes = {}
+            self._id_hash = {}
+        self._prev_spec = spec
+        owned = self._owned_indices(keys, shapes, dtypes)
+        leaves = jax.tree_util.tree_leaves(tree)
+        snap: List = [None] * len(leaves)
+        for i in owned:
+            l = leaves[i]
+            if isinstance(l, np.ndarray):
+                snap[i] = l.copy()  # a trainer may mutate numpy in place
+            elif self.snapshot == "copy":
+                snap[i] = np.array(np.asarray(l), copy=True)
+            else:
+                snap[i] = l  # immutable: writer thread pays the D2H
+        gen = int(step)
+        self._sem.acquire()  # backpressure: double buffer only
+        fut = self._pool.submit(self._job, gen, snap, keys, shapes,
+                                dtypes, step, meta, residual)
+        self._pending.append(fut)
+        if block:
+            self.wait()
+        return gen
+
+    # -- writer thread --------------------------------------------------------
+
+    def _job(self, gen, snap, keys, shapes, dtypes, step, meta,
+             residual):
+        try:
+            # identity shortcut: an owned jax leaf that is the SAME
+            # object the previous generation hashed cannot have
+            # different bytes (immutable, and _prev_snap keeps it
+            # alive so the id is not recycled) — vouch for its hash
+            # and skip both the D2H and the hash pass
+            known: Dict[int, str] = {}
+            if self.incremental:
+                for i, l in enumerate(snap):
+                    if l is None or isinstance(l, np.ndarray):
+                        continue
+                    rec = self._id_hash.get(keys[i])
+                    if rec is not None and rec[0] == id(l):
+                        known[i] = rec[1]
+            info = write_generation(
+                self.directory, gen, snap, keys, shapes, dtypes,
+                step=step, rank=self.rank, nprocs=self.nprocs,
+                chunk_bytes=self.chunk_bytes,
+                incremental=self.incremental,
+                prev_hashes=self._hashes, known_hashes=known,
+                meta=meta, residual=residual)
+            # adopt this generation's ownership for the next delta
+            piece = info.pop("piece")
+            for key, ent in piece["leaves"].items():
+                self._hashes[key] = (ent["hash"], int(ent["gen"]))
+            id_hash: Dict[str, Tuple[int, str]] = {}
+            for i, l in enumerate(snap):
+                if l is None or isinstance(l, np.ndarray):
+                    continue
+                ent = piece["leaves"].get(keys[i])
+                if ent is not None:
+                    id_hash[keys[i]] = (id(l), ent["hash"])
+            self._id_hash = id_hash
+            self._prev_snap = snap  # pins the ids in _id_hash
+            if self.rank == 0:
+                self._gc()
+            self.last_save_info = info
+        # the writer thread must never die silently — ANY failure is
+        # recorded and re-raised at the next save()/wait()/close(); a
+        # lost writer error would silently disable durability
+        # kflint: disable=retry-discipline
+        except BaseException as e:
+            with self._mu:
+                self._errors.append(e)
+        finally:
+            self._sem.release()
+
+    def _gc(self) -> None:
+        """Drop generations no retained manifest references. Runs on
+        rank 0's writer thread only; never touches the newest `keep`
+        complete generations or anything they chain to."""
+        complete = complete_generations(self.directory)
+        keep_list = complete[:self.keep]
+        if not keep_list:
+            return
+        referenced = set(keep_list)
+        for g in keep_list:
+            try:
+                m = load_manifest(self.directory, g)
+            except CheckpointError:
+                return  # racing writer: be conservative, skip GC
+            referenced.update(og for _, og in m.entries.values())
+        floor = min(keep_list)
+        import shutil
+
+        for g in list_generations(self.directory):
+            if g < floor and g not in referenced:
+                shutil.rmtree(_gen_dir(self.directory, g),
+                              ignore_errors=True)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _raise_pending_errors(self) -> None:
+        with self._mu:
+            if self._errors:
+                e = self._errors[0]
+                self._errors.clear()
+                raise CheckpointError(
+                    f"async checkpoint write failed: {e}") from e
+
+    def wait(self) -> None:
+        """Block until every queued generation is durable."""
+        pending, self._pending = self._pending, []
+        for f in pending:
+            f.result()
+        self._raise_pending_errors()
+
+    def close(self) -> None:
+        try:
+            self.wait()
+        finally:
+            self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -18,11 +18,24 @@ the detecting runner proposed, re-broadcasting params+optimizer state
 from the new rank 0 — and continues training with the SAME survivor
 loss-continuity assertion as a planned resize. No operator action.
 
+With KF_CKPT_DIR set the trainer also exercises the DURABLE rung of
+the recovery state machine: every KF_CKPT_EVERY steps each peer
+asynchronously writes its shard of (params, opt_state) — plus its
+per-rank gradient-pipeline residuals — and a COLD-BOOTED cluster
+(launch version 0, i.e. nobody alive to resync from: the whole-cluster
+death case) restores the latest complete generation instead of
+starting from init, re-sharded to whatever np it was launched with.
+The restore proves itself the same way the joiner broadcast does:
+first-batch loss under the restored weights must beat this process's
+fresh init (KF_RESTORE_CONTINUITY marker).
+
 Markers: CONTINUITY_MARKERS in `elastic.harness` — parsed by
 tests/test_elastic.py and the driver's
 `__graft_entry__.dryrun_multichip` elastic phase, both via
 `kungfu_tpu.elastic.harness.run_loss_continuity`; recovery runs add
-KF_RECOVERY_CAUGHT / KF_RECOVERY_DONE (see harness.RECOVERY_MARKERS).
+KF_RECOVERY_CAUGHT / KF_RECOVERY_DONE (see harness.RECOVERY_MARKERS);
+checkpointed runs add KF_CKPT_SAVED / KF_RESTORE_CONTINUITY (see
+harness.run_checkpoint_restore).
 
 Run under kfrun as `python -m kungfu_tpu.elastic.continuity_worker`.
 """
@@ -54,6 +67,10 @@ SCHEDULE = os.environ.get("TEST_SCHEDULE", "6:2,6:4")
 RECOVER = os.environ.get("KF_RECOVER", "0") == "1"
 RECOVERY_DEADLINE_S = float(
     os.environ.get("KF_RECOVERY_DEADLINE_MS", "30000")) / 1e3
+# the durable-checkpoint rung: a directory enables async sharded
+# saves every KF_CKPT_EVERY steps (docs/fault_tolerance.md)
+CKPT_DIR = os.environ.get("KF_CKPT_DIR", "")
+CKPT_EVERY = int(os.environ.get("KF_CKPT_EVERY", "4"))
 BATCH = 64
 LR = 0.1
 
@@ -98,6 +115,36 @@ def make_sampler():
                           offset=elastic.state.trained_samples)
 
 
+ckpt = None
+
+
+def make_checkpointer():
+    """(Re)build the sharded checkpointer for the CURRENT membership —
+    rank/size bind the shard schedule, so every epoch switch (resize or
+    recovery) swaps it; pending writes of the old epoch are drained."""
+    global ckpt
+    if not CKPT_DIR:
+        return
+    from kungfu_tpu.checkpoint_async import AsyncShardedCheckpointer
+    if ckpt is not None:
+        ckpt.close()
+    ckpt = AsyncShardedCheckpointer(CKPT_DIR, peer)
+
+
+def maybe_save():
+    if ckpt is None or CKPT_EVERY <= 0 \
+            or elastic.state.step % CKPT_EVERY != 0:
+        return
+    g = ckpt.save(
+        (params, opt_state), step=elastic.state.step,
+        meta={"trained_samples": elastic.state.trained_samples},
+        residual=pipe.state() if pipe is not None else None)
+    print(f"KF_CKPT_SAVED gen={g} step={elastic.state.step} "
+          f"rank={peer.rank}", flush=True)
+
+
+make_checkpointer()
+
 if peer.config.version > 0:
     # joiner: adopt position + weights, then PROVE the weights are
     # trained state by comparing against this process's fresh init.
@@ -122,7 +169,68 @@ if peer.config.version > 0:
         f"joiner's broadcast weights are no better than a fresh init "
         f"({got_loss:.4f} vs {fresh_loss:.4f}): state broadcast failed")
 else:
-    sampler = make_sampler()
+    # cold boot (launch version 0): the last rung of the recovery
+    # state machine. If a durable checkpoint exists, this cluster is a
+    # relaunch after whole-cluster death — restore the latest complete
+    # generation (re-sharded to THIS np, which may differ from the
+    # saving cluster's) instead of training from init, and PROVE the
+    # restored weights are trained state exactly like a joiner proves
+    # its broadcast.
+    restored = None
+    if ckpt is not None:
+        from kungfu_tpu.checkpoint_async import (CheckpointError,
+                                                 list_generations,
+                                                 restore_sharded)
+        if list_generations(CKPT_DIR):
+            try:
+                # the cold-boot branch IS rank-uniform: EVERY member
+                # of the initial cluster launches with version 0 and
+                # enters the restore rendezvous together; joiners
+                # (version > 0) adopt state via the live broadcast
+                # above instead. The launch-version test separates
+                # boot cohorts, not ranks within one epoch.
+                # kflint: disable=collective-order
+                restored = restore_sharded(CKPT_DIR,
+                                           (params, opt_state),
+                                           peer=peer)
+            except CheckpointError as e:
+                # every rank rejects in lockstep (rank-0 pick + vote),
+                # so falling through to fresh init is cluster-uniform
+                print(f"KF_CKPT_RESTORE_NONE rank={peer.rank}: {e}",
+                      flush=True)
+    if restored is not None:
+        out, step0, meta0, residual0 = restored
+        fresh = params
+        params, opt_state = out
+        elastic.state.step = int(step0)
+        elastic.state.trained_samples = int(
+            meta0.get("trained_samples", 0))
+        if pipe is not None:
+            if residual0 is not None:
+                # survivor semantics: this rank ran in the saving
+                # cluster too — adopt its own residuals byte-exactly
+                pipe.load_state(residual0)
+                print(f"KF_CKPT_RESIDUALS rank={peer.rank} "
+                      f"adopted", flush=True)
+            else:
+                # joiner semantics (restore np > save np): start at
+                # zero, per docs/grad_pipeline.md
+                print(f"KF_CKPT_RESIDUALS rank={peer.rank} zero",
+                      flush=True)
+        sampler = make_sampler()
+        idx = sampler.next_indices()
+        batch = {"x": x[idx], "y": y[idx]}
+        fresh_loss = float(loss_and_grads(fresh, batch)[0])
+        got_loss = float(loss_and_grads(params, batch)[0])
+        print(f"KF_RESTORE_CONTINUITY rank={peer.rank} "
+              f"step={elastic.state.step} fresh={fresh_loss:.4f} "
+              f"restored={got_loss:.4f}", flush=True)
+        assert got_loss < fresh_loss - 0.05, (
+            f"restored weights are no better than a fresh init "
+            f"({got_loss:.4f} vs {fresh_loss:.4f}): the durable "
+            "checkpoint did not carry trained state")
+    else:
+        sampler = make_sampler()
 
 just_recovered = False
 
@@ -149,6 +257,7 @@ def try_recover():
         raise SystemExit(43)  # no recovery stage in time: fail fast
     params, opt_state = out
     sampler = make_sampler()
+    make_checkpointer()  # rank/size changed: rebind the shard schedule
     pending_continuity = last_loss
     just_recovered = True
     print(f"KF_RECOVERY_DONE rank={peer.rank} size={peer.size} "
@@ -213,9 +322,13 @@ while elastic.state.step < TOTAL_STEPS:
         elastic.sync_position()
         params = broadcast_variables(params, peer=peer)
         sampler = make_sampler()
+        make_checkpointer()  # rank/size changed: rebind the schedule
         pending_continuity = last_loss
         print(f"resized: epoch {peer.version} size={peer.size} "
               f"step={elastic.state.step}", flush=True)
+    maybe_save()
 
+if ckpt is not None:
+    ckpt.close()  # drain pending async generations before exit
 print(f"KF_CONTINUITY_DONE rank={peer.rank} size={peer.size} "
       f"step={elastic.state.step} loss={last_loss:.4f}", flush=True)

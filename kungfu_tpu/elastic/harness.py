@@ -27,6 +27,17 @@ CONTINUITY_MARKERS = (
     ("KF_CONTINUITY_DONE", "schedule did not complete"),
 )
 
+CKPT_SAVE_MARKERS = (
+    ("KF_CKPT_SAVED", "no async sharded checkpoint generation landed"),
+    ("KF_CHAOS_FIRE", "the whole-cluster kill never fired"),
+)
+
+CKPT_RESTORE_MARKERS = (
+    ("KF_RESTORE_CONTINUITY",
+     "restored-vs-fresh loss proof did not run"),
+    ("KF_CONTINUITY_DONE", "training did not finish after restore"),
+)
+
 RECOVERY_MARKERS = (
     ("KF_CHAOS_FIRE", "the scheduled fault never fired"),
     ("KF_MTTR detect", "the runner never detected the death"),
@@ -112,7 +123,9 @@ def _run_continuity_cluster(schedule: str,
                     logs += f"--- {f} ---\n" + fh.read()
         # runner stdout carries the KF_MTTR detect/proposed markers
         logs += f"--- runner ---\n{r.stdout}"
-        if r.returncode != expect_rc:
+        bad = (r.returncode == 0 if expect_rc == "nonzero"
+               else r.returncode != expect_rc)
+        if bad:
             raise AssertionError(
                 f"elastic continuity run failed rc={r.returncode} "
                 f"(expected {expect_rc}):\n"
@@ -147,6 +160,86 @@ def run_loss_continuity(schedule: str = "6:2,6:4",
     return _run_continuity_cluster(
         schedule, total_steps, start_np, slots, port_range, timeout,
         logdir, CONTINUITY_MARKERS)
+
+
+def run_checkpoint_restore(ckpt_dir: str,
+                           save_np: int = 4,
+                           restore_np: int = 2,
+                           kill_step: int = 9,
+                           save_every: int = 2,
+                           slots: int = 4,
+                           port_range: str = "27100-27999",
+                           timeout: int = 600,
+                           logdir: str | None = None) -> str:
+    """The durable rung of the recovery state machine, end to end:
+    train at `save_np` with async sharded checkpoints every
+    `save_every` steps, chaos-SIGKILL the WHOLE cluster at `kill_step`
+    (rank unpinned: every worker crashes — the one fault class the
+    survivor-recovery machinery cannot cover), then relaunch at a
+    DIFFERENT size `restore_np` against the same checkpoint directory
+    and assert the cold boot restores the latest complete generation
+    with loss continuity (restored first-batch loss strictly better
+    than this process's fresh init) and a step > 0.
+
+    Returns the combined logs of the restore run."""
+    import json as _json
+    import re as _re
+
+    # phase 1: save under training, then whole-cluster death. The
+    # crash fault pins only the step — every rank matches, so the
+    # entire cluster dies at the same boundary; the runner (no
+    # -recover: nobody survives to recover) fails fast, nonzero.
+    chaos_spec = _json.dumps({"faults": [{
+        "type": "crash_worker", "step": kill_step, "signal": "KILL",
+    }]})
+    # per-phase log directories: phase 2's marker assertions must
+    # never be satisfied by phase 1's stale log files
+    logdir_save = logdir_restore = None
+    if logdir is not None:
+        logdir_save = os.path.join(logdir, "save")
+        logdir_restore = os.path.join(logdir, "restore")
+        os.makedirs(logdir_save, exist_ok=True)
+        os.makedirs(logdir_restore, exist_ok=True)
+    _run_continuity_cluster(
+        schedule=f"{kill_step + 9}:{save_np}",
+        total_steps=kill_step + 8,
+        start_np=save_np,
+        slots=slots,
+        port_range=port_range,
+        timeout=timeout,
+        logdir=logdir_save,
+        markers=CKPT_SAVE_MARKERS,
+        extra_env={
+            "KF_CHAOS": chaos_spec,
+            "KF_CKPT_DIR": ckpt_dir,
+            "KF_CKPT_EVERY": str(save_every),
+        },
+        expect_rc="nonzero",
+    )
+
+    # phase 2: cold boot at a different np, no chaos — restore,
+    # reshard, resume, finish.
+    logs = _run_continuity_cluster(
+        schedule=f"{kill_step + 9}:{restore_np}",
+        total_steps=kill_step + 6,
+        start_np=restore_np,
+        slots=slots,
+        port_range=port_range,
+        timeout=timeout,
+        logdir=logdir_restore,
+        markers=CKPT_SAVE_MARKERS[:1] + CKPT_RESTORE_MARKERS,
+        extra_env={
+            "KF_CHAOS": "",
+            "KF_CKPT_DIR": ckpt_dir,
+            "KF_CKPT_EVERY": str(save_every),
+        },
+    )
+    m = _re.search(r"KF_RESTORE_CONTINUITY rank=\d+ step=(\d+)", logs)
+    if m is None or int(m.group(1)) <= 0:
+        raise AssertionError(
+            "restore did not resume from a positive step:\n"
+            f"{logs[-3000:]}")
+    return logs
 
 
 def run_survivor_recovery(crash_rank: int = 1,

@@ -45,6 +45,11 @@ CONFIG_VARS = (
     "KF_STREAM_CHUNK_MB",
     "KF_GRAD_BUCKET_MB",
     "KF_GRAD_COMPRESS",
+    # durable sharded checkpoints (docs/fault_tolerance.md): the
+    # last rung of the recovery state machine
+    "KF_CKPT_DIR",
+    "KF_CKPT_EVERY",
+    "KF_CKPT_CHUNK_MB",
 )
 
 ALL_BOOTSTRAP_VARS = (

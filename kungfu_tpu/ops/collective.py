@@ -269,6 +269,37 @@ def bucket_schedule(tree_like, bucket_bytes: int) -> List[Tuple[
     return out
 
 
+# -- checkpoint sharding -----------------------------------------------------
+# The sharded checkpoint tier (kungfu_tpu/checkpoint_async.py) divides
+# the tree's bytes across peers so each writes only its shard. The
+# assignment must be a pure function of shapes/dtypes — every rank
+# derives the identical owner map from its own replica, with no
+# negotiation traffic on the save path — so it is a thin layer over
+# chunk_schedule: chunk i belongs to shard (i % num_shards).
+
+
+def shard_schedule(tree_like, chunk_bytes: int,
+                   num_shards: int) -> List[Tuple[int, List[Tuple[int,
+                                                                  int,
+                                                                  int]]]]:
+    """Partition a pytree's bytes into per-shard write chunks.
+
+    Returns ``[(owner, spans), ...]`` — the `chunk_schedule` chunks in
+    order, chunk i owned by shard ``i % num_shards`` (round-robin keeps
+    shard sizes within one chunk of each other for any leaf mix). Spans
+    are ``(leaf_index, byte_offset_in_leaf, nbytes)`` covering every
+    byte of every leaf exactly once. Schedule-only: derived from
+    shapes/dtypes, so every rank computes the identical owner map from
+    its own `tree_like` — the determinism contract the kfverify
+    schedule-purity pass enforces on every feeder of this function.
+    """
+    if num_shards <= 0:
+        raise ValueError(f"num_shards must be positive: {num_shards}")
+    return [(i % num_shards, spans)
+            for i, spans in enumerate(chunk_schedule(tree_like,
+                                                     chunk_bytes))]
+
+
 def subtree_shapes(tree) -> List[Tuple]:
     return [l.shape for l in jax.tree_util.tree_leaves(tree)]
 

@@ -88,6 +88,22 @@ timeout 180 python -m kungfu_tpu.run \
      --compress int8 --backward-ms 50 --bucket-mb 0.1 \
   || { echo "GRAD PIPELINE SMOKE FAILED"; exit 1; }
 
+echo "== [4c/7] checkpoint smoke: save under training -> whole-cluster kill -> reshard restore =="
+# the durable rung of the recovery state machine
+# (docs/fault_tolerance.md): async sharded generations land while a
+# 4-worker cluster trains, a chaos schedule SIGKILLs every worker at
+# one step, and a 2-worker relaunch restores the latest complete
+# generation with loss continuity asserted
+timeout 300 python - <<'EOF'
+import tempfile
+from kungfu_tpu.elastic.harness import run_checkpoint_restore
+with tempfile.TemporaryDirectory() as d:
+    run_checkpoint_restore(d + "/ckpt", save_np=4, restore_np=2,
+                           kill_step=9, save_every=2,
+                           port_range="26000-26999", timeout=240)
+print("CHECKPOINT SMOKE OK")
+EOF
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

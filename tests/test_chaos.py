@@ -10,6 +10,7 @@ scripts/chaos.sh runs the whole matrix.
 """
 
 import json
+import os
 import urllib.error
 import urllib.request
 
@@ -215,6 +216,72 @@ def test_corrupt_checkpoint_is_deterministic_and_loud(tmp_path):
         pytest.fail(
             "load_checkpoint returned instead of failing on a corrupted "
             f"blob (w intact: {np.array_equal(flat['w'], tree['w'])})")
+
+
+class TestShardedCheckpointCorruption:
+    """The seeded corruption schedule, extended to the sharded format:
+    whatever rots — torn shard, missing shard, stale manifest piece —
+    restore must fail loudly or fall back to the previous COMPLETE
+    generation, never silently load a mix."""
+
+    def _save_two_gens(self, d):
+        from kungfu_tpu import checkpoint_async as ca
+
+        trees = []
+        for step in (1, 2):
+            rng = np.random.default_rng(step)
+            tree = {"w": rng.standard_normal(4096).astype(np.float32),
+                    "b": rng.integers(0, 9, 33).astype(np.int64)}
+            gen = ca.next_generation(d)
+            for r in range(2):
+                ca.save_sharded(d, tree, step=step, rank=r, nprocs=2,
+                                chunk_bytes=1024, gen=gen,
+                                incremental=False)
+            trees.append(tree)
+        return trees
+
+    @pytest.mark.parametrize("mode", chaos.SHARDED_CORRUPTIONS)
+    def test_corrupt_newest_falls_back_to_complete(self, tmp_path,
+                                                   mode, capsys):
+        from kungfu_tpu import checkpoint_async as ca
+
+        d = str(tmp_path)
+        t1, _ = self._save_two_gens(d)
+        chaos.corrupt_sharded_generation(ca._gen_dir(d, 2), mode,
+                                         seed=7)
+        out, step, _, _ = ca.restore_sharded(
+            d, {"w": np.zeros(4096, np.float32),
+                "b": np.zeros(33, np.int64)})
+        assert step == 1  # fell back to the previous COMPLETE gen
+        np.testing.assert_array_equal(out["w"], t1["w"])
+        np.testing.assert_array_equal(out["b"], t1["b"])
+        assert "falling back" in capsys.readouterr().out  # loud
+
+    def test_corruption_is_seed_deterministic(self, tmp_path):
+        from kungfu_tpu import checkpoint_async as ca
+
+        d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+        for d in (d1, d2):
+            self._save_two_gens(d)
+        p1 = chaos.corrupt_sharded_generation(
+            ca._gen_dir(d1, 2), "torn_shard", seed=123)
+        p2 = chaos.corrupt_sharded_generation(
+            ca._gen_dir(d2, 2), "torn_shard", seed=123)
+        assert os.path.basename(p1) == os.path.basename(p2)
+        assert os.path.getsize(p1) == os.path.getsize(p2)
+
+    def test_every_generation_corrupt_fails_loudly(self, tmp_path):
+        from kungfu_tpu import checkpoint_async as ca
+
+        d = str(tmp_path)
+        self._save_two_gens(d)
+        for g in (1, 2):
+            chaos.corrupt_sharded_generation(
+                ca._gen_dir(d, g), "missing_shard", seed=g)
+        with pytest.raises(ca.CheckpointError, match="no restorable"):
+            ca.restore_sharded(
+                d, {"w": np.zeros(4096, np.float32),
+                    "b": np.zeros(33, np.int64)})
 
 
 def test_spawn_delay_fault():

@@ -130,6 +130,27 @@ def test_survivor_recovery_after_chaos_worker_kill():
 
 
 @pytest.mark.chaos
+def test_whole_cluster_kill_restores_from_sharded_checkpoint(tmp_path):
+    """The durable rung: the ONE fault class survivor recovery cannot
+    cover. A chaos schedule SIGKILLs EVERY worker at the same step
+    (whole-cluster death, rank-unpinned crash fault); async sharded
+    checkpoint generations were landing under training; a relaunch at
+    a DIFFERENT np restores the latest complete generation (re-sharded
+    2-way from a 4-way save), proves loss continuity vs fresh init,
+    and finishes the run."""
+    from kungfu_tpu.elastic.harness import run_checkpoint_restore
+
+    logs = run_checkpoint_restore(
+        str(tmp_path / "ckpt"), save_np=4, restore_np=2, kill_step=9,
+        save_every=2, port_range="27100-27999", timeout=300)
+    # every restore-cluster rank ran the proof and resumed mid-run
+    assert "KF_RESTORE_CONTINUITY rank=0" in logs, logs[-3000:]
+    assert "KF_RESTORE_CONTINUITY rank=1" in logs, logs[-3000:]
+    # and the restored run kept checkpointing at its own np
+    assert "KF_CKPT_SAVED" in logs, logs[-3000:]
+
+
+@pytest.mark.chaos
 def test_config_server_restart_mid_training(tmp_path):
     """The config server chaos-crashes mid-run and restarts on the same
     port: workers must ride the outage (resize polls tolerate the dead

@@ -978,6 +978,48 @@ def test_schedule_purity_reports_env_get_once():
     assert "os.environ.get()" in findings[0].message
 
 
+def test_schedule_purity_fires_on_shard_schedule_feeder():
+    """The checkpoint shard scheduler is a schedule function too: an
+    env read feeding its chunk size at call time means per-rank owner
+    maps — a checkpoint that looks complete but cannot restore."""
+    findings = fire_project(SchedulePurityPass(), **{"s.py": """
+        import os
+
+        def chunk_from_env():
+            return int(os.getenv("KF_CKPT_CHUNK_MB", "4")) * 2**20
+
+        def save(tree, nprocs):
+            return shard_schedule(tree, chunk_from_env(), nprocs)
+    """})
+    assert len(findings) == 1
+    assert "shard_schedule" in findings[0].message
+    assert "env read" in findings[0].message
+
+
+def test_schedule_purity_quiet_on_shard_schedule_shape_feeder():
+    findings = fire_project(SchedulePurityPass(), **{"s.py": """
+        import os
+
+        import numpy as np
+
+        def from_env():
+            return int(os.getenv("KF_CKPT_CHUNK_MB", "4")) * 2**20
+
+        def spans_bytes(tree):
+            return int(np.prod(np.shape(tree[0]))) * 4
+
+        class Ckpt:
+            def __init__(self, tree, nprocs):
+                # construction-time env read: uniform for the
+                # object's lifetime (AsyncShardedCheckpointer's rule)
+                self._sched = shard_schedule(tree, from_env(), nprocs)
+
+        def save(tree, nprocs):
+            return shard_schedule(tree, spans_bytes(tree), nprocs)
+    """})
+    assert findings == []
+
+
 def test_schedule_purity_quiet_on_init_and_shapes():
     findings = fire_project(SchedulePurityPass(), **{"s.py": """
         import os
