@@ -74,6 +74,7 @@ On-disk layout (one directory per generation)::
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import threading
@@ -90,7 +91,10 @@ from .checkpoint import _path_str, fsync_dir as _fsync_dir
 from .env import env_float
 from .ops.collective import shard_schedule
 
-FORMAT = "kf-sharded-ckpt-v1"
+#: v2 added the mandatory per-piece `shared_sum` self-checksum — a v1
+#: generation is rejected as "unknown format" (restore falls back past
+#: it), not misreported as tampered.
+FORMAT = "kf-sharded-ckpt-v2"
 GEN_PREFIX = "gen-"
 #: default shard chunk size (MiB) — the same granularity trade-off as
 #: the elastic streaming path; override with KF_CKPT_CHUNK_MB.
@@ -142,6 +146,23 @@ def _atomic_write(path: str, data: bytes) -> None:
 
 def _leaf_hash(view: np.ndarray) -> str:
     return blake2b(view, digest_size=16).hexdigest()
+
+
+#: manifest fields every rank's piece must agree on — and that the
+#: per-piece self-checksum covers, so a single-rank save (no cross-rank
+#: agreement possible) is still tamper/tear-evident.
+SHARED_FIELDS = ("format", "gen", "step", "nprocs", "chunk_bytes",
+                 "keys", "shapes", "dtypes", "meta")
+
+
+def _shared_sum(piece: Dict) -> str:
+    """Checksum of a manifest piece's shared fields. Computed over the
+    canonical JSON of the field VALUES, so it survives a JSON
+    round-trip but changes if any shared field is edited in place
+    (e.g. the chaos `mismatch_manifest` step bump)."""
+    blob = json.dumps([piece.get(f) for f in SHARED_FIELDS],
+                      sort_keys=True, separators=(",", ":")).encode()
+    return blake2b(blob, digest_size=16).hexdigest()
 
 
 def _dtype_from_name(name: str) -> np.dtype:
@@ -208,7 +229,8 @@ class Manifest:
                  chunk_bytes: int, keys: List[str],
                  shapes: List[Tuple], dtypes: List[str],
                  entries: Dict[str, Tuple[str, int]],
-                 written_by_rank: List[List[str]], meta: Dict):
+                 written_by_rank: List[List[str]],
+                 residual_by_rank: List[bool], meta: Dict):
         self.directory = directory
         self.gen = gen
         self.step = step
@@ -220,6 +242,8 @@ class Manifest:
         #: key -> (content hash, owning generation)
         self.entries = entries
         self.written_by_rank = written_by_rank
+        #: save-rank -> did that rank commit a residual sidecar
+        self.residual_by_rank = residual_by_rank
         self.meta = meta
 
     @property
@@ -263,63 +287,102 @@ def load_manifest(directory: str, gen: int) -> Manifest:
     except (OSError, ValueError) as e:
         raise CheckpointCorrupt(
             f"gen {gen}: rank-0 manifest unreadable: {e}") from e
+    # valid JSON that is not an object (null, a number, an array) is
+    # still a torn/tampered piece — reject before any .get() attribute
+    # access can escape as AttributeError
+    if not isinstance(head, dict):
+        raise CheckpointCorrupt(
+            f"gen {gen}: rank-0 manifest is not a JSON object")
     if head.get("format") != FORMAT:
         raise CheckpointCorrupt(
             f"gen {gen}: unknown format {head.get('format')!r}")
-    nprocs = int(head["nprocs"])
-    shared = ("format", "gen", "step", "nprocs", "chunk_bytes", "keys",
-              "shapes", "dtypes", "meta")
+    # malformed fields must surface as corruption, not TypeError —
+    # anything escaping CheckpointError here skips the fallback walk
+    try:
+        head_gen = int(head["gen"])
+        nprocs = int(head["nprocs"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointCorrupt(
+            f"gen {gen}: rank-0 manifest malformed: {e}") from e
+    if head_gen != gen:
+        raise CheckpointCorrupt(
+            f"gen {gen}: rank-0 manifest claims gen {head_gen} — "
+            "misplaced or tampered piece")
     entries: Dict[str, Tuple[str, int]] = {}
     written_by_rank: List[List[str]] = []
-    for r in range(nprocs):
-        if r == 0:
-            piece = head
-        else:
-            try:
-                with open(_manifest_path(gen_dir, r)) as f:
-                    piece = json.load(f)
-            except (OSError, ValueError) as e:
-                raise CheckpointCorrupt(
-                    f"gen {gen}: manifest piece for rank {r} "
-                    f"missing/unreadable: {e}") from e
-            for fld in shared:
-                if piece.get(fld) != head.get(fld):
+    residual_by_rank: List[bool] = []
+    # the whole piece walk runs under one malformed-field net: a field
+    # of the wrong type ANYWHERE (shard_bytes "abc", leaves as a list,
+    # a leaf entry's gen null — the non-shared fields the checksum does
+    # not cover) must surface as corruption, because anything escaping
+    # CheckpointError skips the restore fallback walk and, multi-rank,
+    # kills this rank before the ok-vote while peers wait in it
+    try:
+        for r in range(nprocs):
+            if r == 0:
+                piece = head
+            else:
+                try:
+                    with open(_manifest_path(gen_dir, r)) as f:
+                        piece = json.load(f)
+                except (OSError, ValueError) as e:
                     raise CheckpointCorrupt(
-                        f"gen {gen}: manifest pieces disagree on "
-                        f"{fld!r} (rank 0 vs rank {r}) — refusing a "
-                        "mixed restore")
-        for key, ent in piece["leaves"].items():
-            have = entries.get(key)
-            want = (ent["hash"], int(ent["gen"]))
-            if have is not None and have != want:
+                        f"gen {gen}: manifest piece for rank {r} "
+                        f"missing/unreadable: {e}") from e
+                for fld in SHARED_FIELDS:
+                    if piece.get(fld) != head.get(fld):
+                        raise CheckpointCorrupt(
+                            f"gen {gen}: manifest pieces disagree on "
+                            f"{fld!r} (rank 0 vs rank {r}) — refusing "
+                            "a mixed restore")
+            # self-checksum: the only agreement check a single-rank
+            # save has, and a faster/tamper-proof one for multi-rank
+            # pieces too (an edited-in-place shared field otherwise
+            # only surfaces if some OTHER rank's piece still disagrees)
+            if piece.get("shared_sum") != _shared_sum(piece):
                 raise CheckpointCorrupt(
-                    f"gen {gen}: ranks disagree on leaf {key!r} "
-                    "(save-time replica divergence?) — refusing a "
-                    "mixed restore")
-            entries[key] = want
-        written_by_rank.append(list(piece["written"]))
-        shard = _shard_path(gen_dir, r)
-        try:
-            size = os.path.getsize(shard)
-        except OSError as e:
+                    f"gen {gen}: manifest piece for rank {r} fails "
+                    "its shared-field checksum — tampered or torn "
+                    "piece")
+            for key, ent in piece["leaves"].items():
+                have = entries.get(key)
+                want = (ent["hash"], int(ent["gen"]))
+                if have is not None and have != want:
+                    raise CheckpointCorrupt(
+                        f"gen {gen}: ranks disagree on leaf {key!r} "
+                        "(save-time replica divergence?) — refusing a "
+                        "mixed restore")
+                entries[key] = want
+            written_by_rank.append(list(piece["written"]))
+            residual_by_rank.append(bool(piece.get("residual", False)))
+            shard = _shard_path(gen_dir, r)
+            try:
+                size = os.path.getsize(shard)
+            except OSError as e:
+                raise CheckpointCorrupt(
+                    f"gen {gen}: shard file for rank {r} missing: {e}"
+                ) from e
+            if size != int(piece["shard_bytes"]):
+                raise CheckpointCorrupt(
+                    f"gen {gen}: torn shard for rank {r}: {size} "
+                    f"bytes on disk, manifest says "
+                    f"{piece['shard_bytes']}")
+        missing = [k for k in head["keys"] if k not in entries]
+        if missing:
             raise CheckpointCorrupt(
-                f"gen {gen}: shard file for rank {r} missing: {e}"
-            ) from e
-        if size != int(piece["shard_bytes"]):
-            raise CheckpointCorrupt(
-                f"gen {gen}: torn shard for rank {r}: {size} bytes on "
-                f"disk, manifest says {piece['shard_bytes']}")
-    missing = [k for k in head["keys"] if k not in entries]
-    if missing:
+                f"gen {gen}: no rank owns leaves {missing[:3]}...")
+        return Manifest(
+            directory=directory, gen=gen, step=int(head["step"]),
+            nprocs=nprocs, chunk_bytes=int(head["chunk_bytes"]),
+            keys=list(head["keys"]),
+            shapes=[tuple(s) for s in head["shapes"]],
+            dtypes=list(head["dtypes"]), entries=entries,
+            written_by_rank=written_by_rank,
+            residual_by_rank=residual_by_rank,
+            meta=dict(head.get("meta", {})))
+    except (KeyError, TypeError, ValueError, AttributeError) as e:
         raise CheckpointCorrupt(
-            f"gen {gen}: no rank owns leaves {missing[:3]}...")
-    return Manifest(
-        directory=directory, gen=gen, step=int(head["step"]),
-        nprocs=nprocs, chunk_bytes=int(head["chunk_bytes"]),
-        keys=list(head["keys"]),
-        shapes=[tuple(s) for s in head["shapes"]],
-        dtypes=list(head["dtypes"]), entries=entries,
-        written_by_rank=written_by_rank, meta=dict(head.get("meta", {})))
+            f"gen {gen}: manifest malformed: {e}") from e
 
 
 def complete_generations(directory: str) -> List[int]:
@@ -355,6 +418,67 @@ def _host_view(leaf) -> np.ndarray:
     return a.reshape(-1).view(np.uint8)
 
 
+def _gen_format(gen_dir: str) -> Optional[str]:
+    """The format string a generation directory's commit marker
+    claims: the rank-0 manifest's "format" field, "" when the marker
+    is MISSING (abandoned debris or a save still in flight), None when
+    it exists but is unreadable or not a JSON object. One probe shared
+    by the parking rule and GC so their notions of "ours" cannot
+    drift (their policies on ""/None deliberately differ)."""
+    try:
+        with open(_manifest_path(gen_dir, 0)) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return ""
+    except (OSError, ValueError):
+        return None
+    return doc.get("format") if isinstance(doc, dict) else None
+
+
+def _park_foreign_generation(gen_dir: str) -> None:
+    """Move aside a pre-existing generation directory whose manifest
+    this format cannot claim (a pre-upgrade generation GC deliberately
+    preserves). Generation numbers restart with a post-upgrade fresh
+    init, so a later save hitting the same number would otherwise
+    os.replace the very bytes the parking rule promises the operator.
+    The `.parked` suffix drops the directory from `list_generations`,
+    so restore/GC never see it again. A current-format directory is
+    left in place (a recovery redo overwrites it on purpose), as is a
+    directory with no commit marker (our own abandoned debris).
+
+    Multi-rank collisions on a shared FS are racy by nature
+    (check-then-rename): foreignness is re-probed immediately before
+    EVERY rename attempt, so once a peer has parked the foreign dir
+    and recreated a current-format one here, the fresh probe returns
+    and cannot steal it — the residual window is the I/O-free gap
+    between one probe and its rename, and even a lost race only costs
+    one incomplete generation (caught by the completeness check; the
+    foreign bytes themselves are already safely parked)."""
+    for k in range(1000):
+        if not os.path.isdir(gen_dir):
+            return  # gone, or a squatting file: makedirs fails loudly
+        fmt = _gen_format(gen_dir)
+        if fmt == "" or fmt == FORMAT:
+            return
+        dst = f"{gen_dir}.parked" + (f".{k}" if k else "")
+        try:
+            os.rename(gen_dir, dst)
+        except FileNotFoundError:
+            return  # another rank parked it first
+        except OSError as e:
+            if e.errno in (errno.EEXIST, errno.ENOTEMPTY):
+                continue  # dst taken (earlier parking): next suffix
+            raise CheckpointError(
+                f"cannot park foreign-format generation {gen_dir} "
+                f"-> {dst}: {e}") from e
+        print(f"[kf-ckpt] parked foreign-format generation "
+              f"{gen_dir} -> {dst}", flush=True)
+        return
+    raise CheckpointError(
+        f"cannot park foreign-format generation at {gen_dir}: "
+        "out of .parked suffixes")
+
+
 def write_generation(directory: str, gen: int, leaves: List,
                      keys: List[str], shapes: List[Tuple],
                      dtypes: List[str], *, step: int, rank: int,
@@ -379,6 +503,7 @@ def write_generation(directory: str, gen: int, leaves: List,
     timing/volume info."""
     t0 = time.perf_counter()
     gen_dir = _gen_dir(directory, gen)
+    _park_foreign_generation(gen_dir)
     os.makedirs(gen_dir, exist_ok=True)
     schedule = shard_schedule(
         [_Spec(s, _dtype_from_name(d)) for s, d in zip(shapes, dtypes)],
@@ -426,6 +551,16 @@ def write_generation(directory: str, gen: int, leaves: List,
         if h is None or nbytes[i] <= ALWAYS_WRITE_BYTES:
             h = _leaf_hash(view(i))
         prev = prev_hashes.get(keys[i])
+        if prev is not None and prev[1] >= gen:
+            # re-writing an existing generation (a recovery redoing
+            # the step it lost): the chain entry points at the very
+            # bytes the os.replace below destroys, so honoring it
+            # would mark the leaf not-fresh while deleting its only
+            # copy — and GC could then drop the older generations
+            # that still hold real bytes. Force fresh. (save_sharded
+            # filters whole manifests with `g < gen`; this per-entry
+            # guard covers the async front end's live chain too.)
+            prev = None
         fresh = (not incremental or prev is None or prev[0] != h
                  or nbytes[i] <= ALWAYS_WRITE_BYTES)
         entries[keys[i]] = {
@@ -460,6 +595,17 @@ def write_generation(directory: str, gen: int, leaves: List,
             f.flush()
             os.fsync(f.fileno())
         os.replace(rtmp, _residual_path(gen_dir, rank))
+    else:
+        # a redo of this generation may run WITHOUT the gradient
+        # pipeline (relaunch with compression off): the first
+        # attempt's sidecar must not survive it — restore loads
+        # residuals by existence, and a stale one would hand a later
+        # cluster error-feedback state that never matched these
+        # weights
+        try:
+            os.unlink(_residual_path(gen_dir, rank))
+        except FileNotFoundError:
+            pass
     t_write = time.perf_counter()
 
     piece = {
@@ -471,6 +617,10 @@ def write_generation(directory: str, gen: int, leaves: List,
         "shard_bytes": shard_bytes,
         "residual": residual is not None,
     }
+    # compute the checksum over the JSON round-trip of the values so
+    # load-time recomputation sees identical types (tuples -> lists)
+    piece = json.loads(json.dumps(piece))
+    piece["shared_sum"] = _shared_sum(piece)
     _atomic_write(_manifest_path(gen_dir, rank),
                   json.dumps(piece).encode())
     t_done = time.perf_counter()
@@ -727,6 +877,20 @@ def _attempt_generation(directory: str, gen: int, like, rank: int,
                                       nprocs)
     _read_my_spans(manifest, views, restore_schedule, rank)
     residual = _load_residual(manifest.gen_dir, rank)
+    # cross-check the sidecar against the manifest's commitment: a
+    # crash between a redo's sidecar unlink and its manifest commit
+    # leaves a residual:true piece with no sidecar (silent EF-state
+    # loss without this check), and the reverse — a sidecar surviving
+    # from an aborted earlier attempt a residual:false redo committed
+    # over — would hand back state that never matched these weights
+    promised = (manifest.residual_by_rank[rank]
+                if rank < len(manifest.residual_by_rank) else False)
+    if promised and residual is None:
+        raise CheckpointCorrupt(
+            f"gen {gen}: manifest promises a residual sidecar for "
+            f"rank {rank} but none is on disk")
+    if residual is not None and not promised:
+        residual = None  # stale sidecar the manifest does not claim
     return manifest, host, views, (treedef, restore_schedule), residual
 
 
@@ -893,18 +1057,26 @@ class AsyncShardedCheckpointer:
         self.keep = max(1, keep)
         self.snapshot = snapshot
         os.makedirs(directory, exist_ok=True)
+        # -- delta-chain state: writer-thread-owned after __init__.
+        # _hashes/_id_hash/_prev_snap/_chain_spec are read and mutated
+        # ONLY inside _job (plus here, before the pool exists); the
+        # single-worker executor serializes jobs in submit order, so
+        # no lock is needed and a spec change applied by job N can
+        # never be clobbered by a still-in-flight job N-1 — the
+        # reset happens on the same thread, after N-1 fully landed.
         prev = latest_manifest(directory)
         if prev is not None:
             self._hashes: Dict[str, Tuple[str, int]] = dict(
                 prev.entries)
-            self._prev_spec: Optional[Tuple] = (
+            self._chain_spec: Optional[Tuple] = (
                 list(prev.keys), list(prev.shapes),
                 list(prev.dtypes))
         else:
             self._hashes = {}
-            self._prev_spec = None
-        self._schedule = None
+            self._chain_spec = None
+        # -- owned-indices cache: training-thread-owned (save() only)
         self._owned: Optional[set] = None
+        self._sched_spec: Optional[Tuple] = None
         # identity shortcut: key -> (id of the leaf object the hash
         # was computed from, hash). Valid ONLY because _prev_snap
         # keeps those exact objects alive — a freed object's id could
@@ -916,7 +1088,6 @@ class AsyncShardedCheckpointer:
         self._pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="kf-ckpt")
         self._pending: List = []
-        self._keys: Optional[List[str]] = None
         self._mu = threading.Lock()
         self._errors: List[BaseException] = []  # kf: guarded_by(_mu)
         #: timings/volume of the most recent completed write (benign
@@ -926,15 +1097,16 @@ class AsyncShardedCheckpointer:
     # -- snapshot (training thread) ------------------------------------------
 
     def _owned_indices(self, keys, shapes, dtypes) -> set:
-        if self._owned is None or self._keys != keys:
+        spec = (keys, shapes, dtypes)
+        if self._owned is None or self._sched_spec != spec:
             specs = [_Spec(s, _dtype_from_name(d))
                      for s, d in zip(shapes, dtypes)]
-            self._schedule = shard_schedule(specs, self.chunk_bytes,
-                                            self.nprocs)
-            self._owned = {i for owner, spans in self._schedule
+            schedule = shard_schedule(specs, self.chunk_bytes,
+                                      self.nprocs)
+            self._owned = {i for owner, spans in schedule
                            if owner == self.rank
                            for i, _, _ in spans}
-            self._keys = keys
+            self._sched_spec = spec
         return self._owned
 
     def save(self, tree, step: int, *, meta: Optional[Dict] = None,
@@ -959,15 +1131,6 @@ class AsyncShardedCheckpointer:
                 f"save() needs the cluster-agreed step >= 1, got "
                 f"{step} — generation numbers derive from it")
         keys, shapes, dtypes, _ = tree_spec(tree)
-        spec = (keys, shapes, dtypes)
-        if self._prev_spec is not None and self._prev_spec != spec:
-            # tree changed spec (keys OR shapes OR dtypes) vs the
-            # chain so far: restart a full chain — chaining a reshaped
-            # leaf to old generations would save fine but never
-            # restore (the spec-drift check rejects it)
-            self._hashes = {}
-            self._id_hash = {}
-        self._prev_spec = spec
         owned = self._owned_indices(keys, shapes, dtypes)
         leaves = jax.tree_util.tree_leaves(tree)
         snap: List = [None] * len(leaves)
@@ -993,6 +1156,22 @@ class AsyncShardedCheckpointer:
     def _job(self, gen, snap, keys, shapes, dtypes, step, meta,
              residual):
         try:
+            spec = (keys, shapes, dtypes)
+            if self._chain_spec is not None \
+                    and self._chain_spec != spec:
+                # tree changed spec (keys OR shapes OR dtypes) vs the
+                # chain so far: restart a full chain — chaining a
+                # reshaped leaf to old generations would save fine but
+                # never restore (the spec-drift check rejects it).
+                # Applied HERE, on the writer thread, so an in-flight
+                # old-spec job (which repopulates the chain state when
+                # it lands) has fully landed before the reset — the
+                # training thread clearing these dicts could race a
+                # pending write refilling them with pre-restart gens.
+                self._hashes = {}
+                self._id_hash = {}
+                self._prev_snap = None
+            self._chain_spec = spec
             # identity shortcut: an owned jax leaf that is the SAME
             # object the previous generation hashed cannot have
             # different bytes (immutable, and _prev_snap keeps it
@@ -1058,9 +1237,23 @@ class AsyncShardedCheckpointer:
         import shutil
 
         for g in list_generations(self.directory):
-            if g < floor and g not in referenced:
-                shutil.rmtree(_gen_dir(self.directory, g),
-                              ignore_errors=True)
+            if g >= floor or g in referenced:
+                continue
+            # never delete bytes GC cannot attribute to THIS format's
+            # chain: a pre-upgrade (e.g. v1) generation would restore
+            # nowhere after a silent fresh init, and rmtree'ing it
+            # here would turn that regression into permanent loss.
+            # A missing commit marker ("") is our own abandoned debris
+            # (crashed mid-save) and stays collectable; an unreadable
+            # or foreign-format manifest makes GC LEAVE the directory
+            # for the operator (restore already rejects it loudly;
+            # write_generation moves it to a .parked name only if a
+            # new save collides with its number).
+            fmt = _gen_format(_gen_dir(self.directory, g))
+            if fmt not in ("", FORMAT):
+                continue
+            shutil.rmtree(_gen_dir(self.directory, g),
+                          ignore_errors=True)
 
     # -- lifecycle ------------------------------------------------------------
 

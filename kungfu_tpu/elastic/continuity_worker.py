@@ -179,25 +179,34 @@ else:
     restored = None
     if ckpt is not None:
         from kungfu_tpu.checkpoint_async import (CheckpointError,
-                                                 list_generations,
                                                  restore_sharded)
-        if list_generations(CKPT_DIR):
-            try:
-                # the cold-boot branch IS rank-uniform: EVERY member
-                # of the initial cluster launches with version 0 and
-                # enters the restore rendezvous together; joiners
-                # (version > 0) adopt state via the live broadcast
-                # above instead. The launch-version test separates
-                # boot cohorts, not ranks within one epoch.
-                # kflint: disable=collective-order
-                restored = restore_sharded(CKPT_DIR,
-                                           (params, opt_state),
-                                           peer=peer)
-            except CheckpointError as e:
-                # every rank rejects in lockstep (rank-0 pick + vote),
-                # so falling through to fresh init is cluster-uniform
-                print(f"KF_CKPT_RESTORE_NONE rank={peer.rank}: {e}",
-                      flush=True)
+        try:
+            # the cold-boot branch IS rank-uniform: EVERY member
+            # of the initial cluster launches with version 0 and
+            # enters the restore rendezvous together; joiners
+            # (version > 0) adopt state via the live broadcast
+            # above instead. The launch-version test separates
+            # boot cohorts, not ranks within one epoch.
+            #
+            # Entered UNCONDITIONALLY — no local list_generations
+            # gate: whether a generation exists is decided inside
+            # restore_sharded by rank 0's pick broadcast, so a
+            # lagging or divergent local view of KF_CKPT_DIR (which
+            # must be shared storage, see docs/fault_tolerance.md)
+            # cannot split the cluster into some ranks joining the
+            # restore collectives while others skip to fresh init —
+            # a version-0 boot deadlock. "No checkpoint at all" is
+            # the same agreed walk reporting no candidate: every
+            # rank raises together.
+            # kflint: disable=collective-order
+            restored = restore_sharded(CKPT_DIR,
+                                       (params, opt_state),
+                                       peer=peer)
+        except CheckpointError as e:
+            # every rank rejects in lockstep (rank-0 pick + vote),
+            # so falling through to fresh init is cluster-uniform
+            print(f"KF_CKPT_RESTORE_NONE rank={peer.rank}: {e}",
+                  flush=True)
     if restored is not None:
         out, step0, meta0, residual0 = restored
         fresh = params

@@ -185,6 +185,166 @@ class TestIncremental:
         m3 = ca.load_manifest(d, 3)
         assert m3.entries["h"][1] == 3
 
+    def test_resave_same_generation_keeps_bytes(self, tmp_path):
+        """A recovery redoing the step it lost re-saves the SAME
+        generation with a live delta chain (review regression: the
+        chain entry then pointed at the very generation being
+        rewritten, so the leaf was marked not-fresh while os.replace
+        destroyed its only bytes — and rank-0 GC could drop the older
+        generations still holding real data). The redo must force
+        those leaves fresh and the generation must stay restorable."""
+        d = str(tmp_path)
+        tree = mixed_tree(3)
+        with ca.AsyncShardedCheckpointer(d) as ckpt:
+            ckpt.save(tree, step=1, block=True)
+            tree2 = {**tree, "w": tree["w"] + 1.0}
+            ckpt.save(tree2, step=2, block=True)
+            # recovery redo of step 2: same gen, same bytes, chain now
+            # maps "w" to gen 2 itself
+            ckpt.save(tree2, step=2, block=True)
+        m = ca.load_manifest(d, 2)
+        assert m.entries["w"][1] == 2
+        assert "w" in m.written_by_rank[0]  # bytes actually on disk
+        assert m.entries["h"][1] == 1  # cross-gen chaining still works
+        out, step, _, _ = ca.restore_sharded(d, mixed_tree(9))
+        assert step == 2
+        assert_tree_equal(out, tree2)
+
+    def test_resave_without_residual_drops_stale_sidecar(self,
+                                                         tmp_path):
+        """A redo of a generation WITHOUT the gradient pipeline
+        (relaunch with compression off) must remove the first
+        attempt's residual sidecar — restore loads residuals by
+        existence, and a stale one would hand a later compressed run
+        error-feedback state that never matched the redone weights."""
+        d = str(tmp_path)
+        tree = mixed_tree(1)
+        res = {"compression": "int8",
+               "residual": [np.ones(8, np.float32)]}
+        ca.save_sharded(d, tree, step=1, residual=res)
+        _, _, _, r = ca.restore_sharded(d, mixed_tree(9))
+        assert r is not None
+        ca.save_sharded(d, tree, step=1, gen=1, residual=None)  # redo
+        out, step, _, r = ca.restore_sharded(d, mixed_tree(9))
+        assert r is None
+        assert step == 1
+        assert_tree_equal(out, tree)
+
+    def test_residual_flag_crosschecked_against_sidecar(self,
+                                                        tmp_path):
+        """The manifest's residual commitment must match the disk:
+        a promised-but-missing sidecar (crash between a redo's unlink
+        and its manifest commit) is corruption, and an unclaimed
+        sidecar (aborted earlier attempt) is ignored — existence
+        alone decides neither."""
+        d = str(tmp_path / "missing")
+        tree = mixed_tree(1)
+        res = {"compression": "int8",
+               "residual": [np.ones(8, np.float32)]}
+        ca.save_sharded(d, tree, step=1, residual=res)
+        os.unlink(ca._residual_path(ca._gen_dir(d, 1), 0))
+        with pytest.raises(ca.CheckpointError, match="promises"):
+            ca.restore_sharded(d, mixed_tree(9))
+        d = str(tmp_path / "stale")
+        ca.save_sharded(d, tree, step=1)  # residual:false
+        rp = ca._residual_path(ca._gen_dir(d, 1), 0)
+        np.savez(rp[:-4], compression=np.asarray("int8"),
+                 res_0=np.ones(8, np.float32))
+        _, _, _, r = ca.restore_sharded(d, mixed_tree(9))
+        assert r is None  # unclaimed sidecar ignored
+
+    def test_spec_change_with_inflight_write_restarts_chain(
+            self, tmp_path, monkeypatch):
+        """A spec change queued while the previous generation is still
+        writing (review regression: the training thread cleared the
+        chain state, then the in-flight old-spec job repopulated it,
+        so the new chain's first generation could delta-reference
+        pre-restart generations and be rejected at restore). The reset
+        now happens on the writer thread, strictly after the old-spec
+        job lands. Gen 1's write is stalled on an event until the
+        new-spec save() has returned, so the race is deterministic —
+        the buggy ordering (training-thread reset, THEN old-spec job
+        repopulating the chain) is forced, not left to timing."""
+        gate = threading.Event()
+        orig = ca.write_generation
+
+        def stalled(directory, gen, *a, **k):
+            if gen == 1:
+                assert gate.wait(30)
+            return orig(directory, gen, *a, **k)
+
+        monkeypatch.setattr(ca, "write_generation", stalled)
+        d = str(tmp_path)
+        t1 = {"w": np.arange(4096, dtype=np.float32),
+              "h": np.ones(512, np.float32)}
+        with ca.AsyncShardedCheckpointer(d) as ckpt:
+            ckpt.save(t1, step=1)  # writer stalls inside gen 1's job
+            t2 = {"w": np.arange(8192, dtype=np.float32),  # resized
+                  "h": t1["h"]}                            # unchanged
+            ckpt.save(t2, step=2)  # queued while gen 1 is in flight
+            gate.set()
+        m = ca.load_manifest(d, 2)
+        # the unchanged leaf must NOT chain across the spec change
+        assert m.entries["h"][1] == 2
+        out, step, _, _ = ca.restore_sharded(
+            d, {"w": np.zeros(8192, np.float32),
+                "h": np.zeros(512, np.float32)})
+        assert step == 2
+        np.testing.assert_array_equal(out["w"], t2["w"])
+
+    def test_gc_never_deletes_foreign_format_generations(self,
+                                                         tmp_path):
+        """After a FORMAT bump, pre-upgrade generations are rejected
+        at restore (loudly) — but GC must never rmtree them: that
+        would turn the fresh-init regression into permanent loss of
+        the old-format training state. Current-format debris below
+        the floor is still collected."""
+        d = str(tmp_path)
+        v1dir = ca._gen_dir(d, 1)
+        os.makedirs(v1dir)
+        with open(ca._manifest_path(v1dir, 0), "w") as f:
+            json.dump({"format": "kf-sharded-ckpt-v1"}, f)
+        # a manifest that parses to a NON-OBJECT must also park (and
+        # must not crash the GC job, which would poison every save)
+        nulldir = ca._gen_dir(d, 0)
+        os.makedirs(nulldir)
+        with open(ca._manifest_path(nulldir, 0), "w") as f:
+            f.write("null")
+        tree = mixed_tree(1)
+        with ca.AsyncShardedCheckpointer(d, keep=2,
+                                         incremental=False) as ckpt:
+            for s in range(2, 7):
+                ckpt.save(tree, step=s, block=True)
+        gens = ca.list_generations(d)
+        assert {0, 1} <= set(gens)  # foreign bytes parked, not lost
+        assert 2 not in gens      # current-format old gens collected
+        assert {5, 6} <= set(gens)
+
+    def test_save_parks_foreign_generation_not_overwrites(self,
+                                                          tmp_path):
+        """Post-upgrade steps restart from a fresh init, so a save can
+        COLLIDE with a preserved pre-upgrade generation number — the
+        old directory must be moved aside (.parked, invisible to
+        list_generations), never os.replace'd in place."""
+        d = str(tmp_path)
+        v1dir = ca._gen_dir(d, 2)
+        os.makedirs(v1dir)
+        with open(ca._manifest_path(v1dir, 0), "w") as f:
+            json.dump({"format": "kf-sharded-ckpt-v1"}, f)
+        with open(ca._shard_path(v1dir, 0), "wb") as f:
+            f.write(b"v1-bytes")
+        tree = mixed_tree(1)
+        with ca.AsyncShardedCheckpointer(d) as ckpt:
+            ckpt.save(tree, step=2, block=True)
+        out, step, _, _ = ca.restore_sharded(d, mixed_tree(9))
+        assert step == 2
+        assert_tree_equal(out, tree)
+        parked = [n for n in os.listdir(d) if ".parked" in n]
+        assert parked == ["gen-00000002.parked"]
+        with open(os.path.join(d, parked[0], "shard-r0.bin"),
+                  "rb") as f:
+            assert f.read() == b"v1-bytes"  # old bytes intact
+
     def test_non_incremental_rewrites_everything(self, tmp_path):
         d = str(tmp_path)
         t = mixed_tree(1)
@@ -303,6 +463,61 @@ class TestCorruptionFallback:
         piece["step"] = 99
         with open(mpath, "w") as f:
             json.dump(piece, f)
+        out, step, _, _ = ca.restore_sharded(d, mixed_tree(9))
+        assert step == 1
+        assert_tree_equal(out, t1)
+
+    def test_single_rank_manifest_tamper_detected(self, tmp_path):
+        """nprocs==1 has no cross-rank agreement check: the piece's
+        self-checksum must catch a tampered/stale shared field (review
+        regression: a chaos-style step bump passed every leaf-hash
+        check and silently skewed the restored step/sampler)."""
+        d = str(tmp_path)
+        t1 = mixed_tree(1)
+        ca.save_sharded(d, t1, step=1)
+        ca.save_sharded(d, mixed_tree(2), step=2, incremental=False)
+        mpath = ca._manifest_path(ca._gen_dir(d, 2), 0)
+        with open(mpath) as f:
+            piece = json.load(f)
+        piece["step"] = 99
+        with open(mpath, "w") as f:
+            json.dump(piece, f)
+        out, step, _, _ = ca.restore_sharded(d, mixed_tree(9))
+        assert step == 1  # fell back, never returned the skewed step
+        assert_tree_equal(out, t1)
+
+    def test_malformed_nonshared_field_falls_back(self, tmp_path):
+        """A malformed field OUTSIDE the checksummed shared set (e.g.
+        shard_bytes as a string, a leaf entry's gen null) must surface
+        as CheckpointCorrupt and fall back — a bare TypeError would
+        skip the fallback walk and, multi-rank, strand peers in the
+        ok-vote."""
+        d = str(tmp_path)
+        t1, _ = self._two_gens(d)
+        mpath = ca._manifest_path(ca._gen_dir(d, 2), 1)
+        with open(mpath) as f:
+            piece = json.load(f)
+        piece["shard_bytes"] = "abc"
+        piece["leaves"] = {k: {**e, "gen": None}
+                           for k, e in piece["leaves"].items()}
+        with open(mpath, "w") as f:
+            json.dump(piece, f)
+        with pytest.raises(ca.CheckpointCorrupt):
+            ca.load_manifest(d, 2)
+        out, step, _, _ = ca.restore_sharded(d, mixed_tree(9))
+        assert step == 1
+        assert_tree_equal(out, t1)
+
+    def test_non_object_manifest_json_falls_back(self, tmp_path):
+        """A manifest that parses to valid non-object JSON (null,
+        array — a torn piece shape) must be corruption, not an
+        AttributeError escaping the fallback walk."""
+        d = str(tmp_path)
+        t1, _ = self._two_gens(d)
+        with open(ca._manifest_path(ca._gen_dir(d, 2), 0), "w") as f:
+            f.write("null")
+        with pytest.raises(ca.CheckpointCorrupt):
+            ca.load_manifest(d, 2)
         out, step, _, _ = ca.restore_sharded(d, mixed_tree(9))
         assert step == 1
         assert_tree_equal(out, t1)
