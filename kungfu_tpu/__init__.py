@@ -63,6 +63,12 @@ def init() -> Peer:
     if _default_peer is None:
         _default_peer = Peer().start()
         atexit.register(shutdown)
+        # kftrace (docs/observability.md): bind the SPMD context, arm
+        # the flight recorder, start the /trace shipper — all no-ops
+        # unless KF_TRACE=1
+        from . import trace
+
+        trace.install_from_peer(_default_peer)
     return _default_peer
 
 

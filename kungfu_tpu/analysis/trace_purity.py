@@ -23,7 +23,15 @@ or used as a ``shard_map`` body — and flags, anywhere inside:
   traced parameter (static metadata — ``.ndim`` / ``.shape`` /
   ``.dtype`` / ``len()`` — and ``is None`` checks are exempt; params
   named by ``static_argnames``/``static_argnums`` literals are not
-  tracers and are exempt too).
+  tracers and are exempt too)
+- kftrace recorder calls: ``trace.span`` / ``trace.event`` /
+  ``trace.counter`` / ``trace.complete`` / ``trace.flight_dump`` /
+  ``trace.set_context`` (any ``trace``/``kftrace`` module prefix).
+  A recorder call inside a jitted body runs at TRACE time — it
+  records one event at compile, then never again — and the wall
+  clocks inside `span` would be frozen constants. Instrumentation
+  wraps the CALL SITE of a compiled step, never its body
+  (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -44,6 +52,20 @@ _JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
 _SHARD_MAP_NAMES = {"shard_map", "jax.shard_map"}
 _STATIC_ATTRS = {"ndim", "shape", "dtype", "size", "sharding"}
 _CASTS = {"float", "int", "bool"}
+#: kftrace recorder entry points (kungfu_tpu/trace/__init__.py) — any
+#: dotted call whose module segment is trace/kftrace and whose final
+#: segment is one of these fires inside a jit/shard_map body
+_RECORDER_FUNCS = {"span", "event", "counter", "complete",
+                   "flight_dump", "set_context"}
+_RECORDER_MODULES = {"trace", "kftrace"}
+
+
+def _is_recorder_call(cn: Optional[str]) -> bool:
+    if not cn or "." not in cn:
+        return False
+    parts = cn.split(".")
+    return (parts[-1] in _RECORDER_FUNCS
+            and parts[-2] in _RECORDER_MODULES)
 
 
 def _is_jit_expr(node: ast.AST) -> bool:
@@ -200,7 +222,13 @@ class TracePurityPass:
             for node in ast.walk(stmt):
                 if isinstance(node, ast.Call):
                     cn = call_name(node)
-                    if cn in _CLOCK_CALLS:
+                    if _is_recorder_call(cn):
+                        add(node, f"kftrace recorder call {cn}() "
+                                  "inside a jitted step records at "
+                                  "trace time, not per step — wrap "
+                                  "the call site of the compiled "
+                                  "step instead")
+                    elif cn in _CLOCK_CALLS:
                         add(node, f"{cn}() is frozen into the trace at "
                                   "compile time — wall clocks cannot "
                                   "live inside a jitted step")

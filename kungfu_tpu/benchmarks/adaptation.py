@@ -89,11 +89,46 @@ def worker(args) -> int:
     return 0
 
 
-def _run_schedule(args, chunk_mb, logdir, capture: bool):
+def resize_phases_from_trace(trace_dir: str) -> list:
+    """Per-resize phase decomposition from kftrace flight records.
+
+    Each `resize.resync` span (`elastic/hooks.py`) carries the full
+    `last_resize_timings` dict in its args — the same numbers the
+    worker prints on its `resize a->b` stdout line. Reading them from
+    the structured events replaces the stdout-regex path when the run
+    was launched with tracing (the marker parse in `sweep()` remains
+    the fallback). Returns one dict per rank-0 resize span (the root
+    pays the pack+broadcast the sweep decomposes), sorted by time.
+    `total_ms` here is the resync window — the payload-bound part the
+    sweep exists to decompose; the stdout fallback's total also
+    includes the consensus wait upstream of it."""
+    from kungfu_tpu.trace.export import merge_sources, read_flight_dir
+
+    events, _ = merge_sources(read_flight_dir(trace_dir))
+    rows = []
+    for e in events:
+        if e.get("name") != "resize.resync" or e.get("ph") != "X":
+            continue
+        if e.get("rank", -1) != 0:
+            continue
+        d = {"t_ms": e["ts"] / 1e3,
+             "total_ms": e.get("dur", 0) / 1e3,
+             "step": e.get("step"), "version": e.get("version")}
+        for k, v in (e.get("args") or {}).items():
+            if isinstance(v, (int, float)):
+                d[k] = float(v)
+        rows.append(d)
+    return sorted(rows, key=lambda d: d["t_ms"])
+
+
+def _run_schedule(args, chunk_mb, logdir, capture: bool,
+                  trace_dir: str = ""):
     """Boot config server + elastic kfrun around one schedule run.
 
     Returns the CompletedProcess (output captured when `capture`) —
-    the single launch body `launch()` and `sweep()` share."""
+    the single launch body `launch()` and `sweep()` share. With
+    `trace_dir`, the cluster runs under KF_TRACE=1 and flight-dumps
+    there (the structured decomposition source)."""
     import subprocess
 
     from kungfu_tpu.elastic import ConfigServer
@@ -103,6 +138,9 @@ def _run_schedule(args, chunk_mb, logdir, capture: bool):
         env = dict(os.environ)
         env.setdefault("KF_TIMEOUT_MS", "60000")
         env.setdefault("KF_LOG_LEVEL", "warn")
+        if trace_dir:
+            env["KF_TRACE"] = "1"
+            env["KF_TRACE_DIR"] = trace_dir
         # control-plane-only workers: no accelerator needed, and the
         # benchmark must not serialize on the machine's single TPU
         env["JAX_PLATFORMS"] = "cpu"
@@ -142,27 +180,47 @@ def sweep(args) -> int:
     results = []
     for chunk_mb in args.chunk_mb_sweep:
         # rerun the launch body with output captured so the per-resize
-        # phase lines can be aggregated here
-        proc = _run_schedule(args, chunk_mb,
-                             f"{args.logdir}-c{chunk_mb:g}",
-                             capture=True)
-        sys.stderr.write(proc.stderr)
-        phases = []
-        # worker lines arrive through kfrun's log tee with a colored
-        # per-rank prefix, on either stream — search, don't anchor
-        for line in (proc.stdout + "\n" + proc.stderr).splitlines():
-            m = re.search(r"resize (\d+)->(\d+) ([\d.]+) ms \| (.*)", line)
-            if not m:
-                continue
-            d = {"from": int(m.group(1)), "to": int(m.group(2)),
-                 "total_ms": float(m.group(3))}
-            for kv in m.group(4).split():
-                k, _, v = kv.partition("=")
-                try:
-                    d[k] = float(v)
-                except ValueError:
-                    pass
-            phases.append(d)
+        # decomposition can be aggregated here; each run flight-dumps
+        # into its own trace dir — the structured source
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            proc = _run_schedule(args, chunk_mb,
+                                 f"{args.logdir}-c{chunk_mb:g}",
+                                 capture=True,
+                                 trace_dir="" if args.no_trace else td)
+            sys.stderr.write(proc.stderr)
+            phases = ([] if args.no_trace
+                      else resize_phases_from_trace(td))
+        source = "kftrace" if phases else "markers"
+        if phases:
+            # structured path: sizes come from the resize.resync span
+            # args; derive from/to by walking from the launch size
+            prev = args.np
+            for d in phases:
+                d["from"] = prev
+                d["to"] = int(d.get("size", prev))
+                prev = d["to"]
+        else:
+            # fallback: regex over the worker's stdout lines (runs
+            # with tracing off, or a trace that failed to land).
+            # Worker lines arrive through kfrun's log tee with a
+            # colored per-rank prefix, on either stream — search,
+            # don't anchor.
+            for line in (proc.stdout + "\n" + proc.stderr).splitlines():
+                m = re.search(r"resize (\d+)->(\d+) ([\d.]+) ms \| (.*)",
+                              line)
+                if not m:
+                    continue
+                d = {"from": int(m.group(1)), "to": int(m.group(2)),
+                     "total_ms": float(m.group(3))}
+                for kv in m.group(4).split():
+                    k, _, v = kv.partition("=")
+                    try:
+                        d[k] = float(v)
+                    except ValueError:
+                        pass
+                phases.append(d)
         # the grow resizes (to > from) carry the joiner broadcast —
         # the payload-bound phase this sweep exists to decompose
         grows = [d for d in phases if d["to"] > d["from"]]
@@ -172,9 +230,13 @@ def sweep(args) -> int:
             vals = [d[key] for d in grows if key in d]
             if vals:
                 agg[key] = round(float(np.mean(vals)), 1)
+        # `source` matters for cross-row comparability: the kftrace
+        # total_ms covers the resync window only, while the stdout
+        # fallback's total also includes the consensus wait — a row
+        # that silently fell back must be identifiable as such
         row = {"chunk_mb": chunk_mb, "resizes": len(phases),
                "grows": len(grows), "payload_mb": args.payload_mb,
-               "rc": proc.returncode, **agg}
+               "source": source, "rc": proc.returncode, **agg}
         results.append(row)
         print(json.dumps({"metric": "elastic_resync_chunk_sweep",
                           "value": agg.get("total_ms"),
@@ -223,6 +285,10 @@ def main(argv=None) -> int:
                          "baseline)")
     ap.add_argument("--port-range", default="27000-27999")
     ap.add_argument("--logdir", default=".kf-adaptation-logs")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="(driver) decompose resizes from worker "
+                         "stdout lines instead of kftrace flight "
+                         "records")
     args = ap.parse_args(argv)
     if args.chunk_mb_sweep:
         return sweep(args)

@@ -2,9 +2,8 @@
 
 The chaos engine SIGKILLs one worker at a scheduled step inside a real
 kfrun -recover cluster (the same harness the failure-injection tests
-drive); this module parses the KF_CHAOS_FIRE / KF_MTTR marker timeline
-out of the logs and publishes the decomposition VERDICT r5 item 7 asked
-for on the elastic path:
+drive); this module decomposes the recovery timeline and publishes the
+breakdown VERDICT r5 item 7 asked for on the elastic path:
 
     crash ──detect──▶ runner notices the death        (supervisor poll)
           ──propose─▶ shrunken stage PUT to config server
@@ -22,6 +21,18 @@ runner's 0.25 s supervision poll; `adopt` is the survivors' recovery
 poll backoff (KF_RETRY_* knobs) plus the join barrier; `restore` scales
 with model bytes over DCN (see benchmarks/adaptation.py for the
 payload-sweep version of that cost).
+
+Two decomposition sources (docs/observability.md):
+
+- **kftrace flight-recorder events** (the default): each run launches
+  with KF_TRACE=1 + a KF_TRACE_DIR, the chaos victim flight-dumps its
+  ring BEFORE the SIGKILL fires, survivors and the runner dump theirs,
+  and `decompose_events` reads the structured recovery span tree.
+- **KF_MTTR stdout markers** (the fallback, and the cross-check): the
+  pre-round-11 regex timeline, kept so the benchmark still runs with
+  tracing off — and so each run can ASSERT the two decompositions
+  agree (they share wall clocks; disagreement means an instrumentation
+  bug, and `--no-trace` bypasses the whole structured path).
 """
 
 from __future__ import annotations
@@ -31,7 +42,17 @@ import json
 import re
 import statistics
 import sys
+import tempfile
 from typing import Dict, List, Optional
+
+#: per-phase agreement tolerance between the marker and the kftrace
+#: decompositions: both derive from time.time() on the same host
+#: (typical deltas are <5%, see BASELINE), but each marker/event pair
+#: straddles a print() that can block under load, so the check allows
+#: an absolute scheduling-noise floor OR a relative band — anything
+#: beyond BOTH is an instrumentation bug, not host jitter
+AGREE_TOL_MS = 100.0
+AGREE_TOL_REL = 0.15
 
 
 def _marker_times(logs: str, marker: str) -> List[float]:
@@ -71,18 +92,61 @@ def decompose(logs: str) -> Optional[Dict[str, float]]:
     }
 
 
+def decompose_events(trace_dir: str) -> Optional[Dict[str, float]]:
+    """MTTR decomposition from the flight-recorder events under
+    `trace_dir`, or None when the structured timeline is incomplete
+    (e.g. the run was launched without KF_TRACE=1)."""
+    from ..trace.export import (merge_sources, read_flight_dir,
+                                recovery_decomposition)
+
+    events, _ = merge_sources(read_flight_dir(trace_dir))
+    return recovery_decomposition(events)
+
+
+def check_agreement(a: Dict[str, float], b: Dict[str, float],
+                    tol_ms: float = AGREE_TOL_MS,
+                    tol_rel: float = AGREE_TOL_REL) -> List[str]:
+    """Phase-by-phase disagreements beyond BOTH the absolute floor
+    and the relative band ([] = agree)."""
+    out = []
+    for k in sorted(set(a) & set(b)):
+        if not isinstance(a[k], (int, float)) \
+                or not isinstance(b[k], (int, float)):
+            continue
+        tol = max(tol_ms, tol_rel * max(abs(a[k]), abs(b[k])))
+        if abs(a[k] - b[k]) > tol:
+            out.append(f"{k}: markers={a[k]:.1f} ms vs "
+                       f"kftrace={b[k]:.1f} ms (tol {tol:.0f})")
+    return out
+
+
 def run_once(np_: int, crash_rank: int, crash_step: int,
-             port_range: str) -> Dict[str, float]:
+             port_range: str, trace: bool = True) -> Dict[str, float]:
     from ..elastic.harness import run_survivor_recovery
 
-    logs = run_survivor_recovery(
-        crash_rank=crash_rank, crash_step=crash_step,
-        total_steps=crash_step + 7, start_np=np_,
-        port_range=port_range, timeout=300)
-    d = decompose(logs)
-    if d is None:
+    with tempfile.TemporaryDirectory() as td:
+        extra_env = None
+        if trace:
+            extra_env = {"KF_TRACE": "1", "KF_TRACE_DIR": td}
+        logs = run_survivor_recovery(
+            crash_rank=crash_rank, crash_step=crash_step,
+            total_steps=crash_step + 7, start_np=np_,
+            port_range=port_range, timeout=300,
+            extra_env=extra_env)
+        d_markers = decompose(logs)
+        d_events = decompose_events(td) if trace else None
+    if d_markers is None and d_events is None:
         raise RuntimeError(
             f"marker timeline incomplete:\n{logs[-3000:]}")
+    if d_markers is not None and d_events is not None:
+        bad = check_agreement(d_markers, d_events)
+        if bad:
+            raise RuntimeError(
+                "marker and kftrace decompositions disagree beyond "
+                f"the {AGREE_TOL_MS:.0f} ms / "
+                f"{AGREE_TOL_REL:.0%} tolerance: " + "; ".join(bad))
+    d = dict(d_events if d_events is not None else d_markers)
+    d["source"] = "kftrace" if d_events is not None else "markers"
     return d
 
 
@@ -96,12 +160,15 @@ def main(argv=None) -> int:
     ap.add_argument("--port-range", default="27100-27999")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON line")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="markers-only decomposition (skip kftrace "
+                         "flight recording and the agreement check)")
     args = ap.parse_args(argv)
 
     rows = []
     for i in range(args.runs):
         d = run_once(args.np, args.crash_rank, args.crash_step,
-                     args.port_range)
+                     args.port_range, trace=not args.no_trace)
         rows.append(d)
         print(
             f"run {i + 1}/{args.runs}: mttr={d['mttr_ms']:.0f} ms "
@@ -111,13 +178,15 @@ def main(argv=None) -> int:
             f"{d['resume_ms']:.0f})",
             flush=True,
         )
-    agg = {k: statistics.median(r[k] for r in rows) for k in rows[0]}
+    agg = {k: statistics.median(r[k] for r in rows) for k in rows[0]
+           if isinstance(rows[0][k], (int, float))}
     summary = {
         "benchmark": "failure_recovery_mttr",
         "np": args.np,
         "crash_rank": args.crash_rank,
         "crash_step": args.crash_step,
         "runs": args.runs,
+        "source": rows[0].get("source", "markers"),
         **{k: round(v, 1) for k, v in agg.items()},
     }
     if args.json:

@@ -187,9 +187,24 @@ def _reset() -> None:
 
 
 def _fire(ftype: str, **info) -> None:
+    """Announce a fault: marker line + structured kftrace event. The
+    event is emitted BEFORE any destructive action runs (the callers'
+    contract) so a fault that takes this very process down is still in
+    the ring when the flight recorder dumps — an MTTR decomposition
+    can then anchor on the victim's own record instead of inferring
+    the crash instant from survivor-side symptoms."""
     kv = " ".join(f"{k}={v}" for k, v in info.items())
     print(f"KF_CHAOS_FIRE t={time.time() * 1e3:.1f} type={ftype} {kv}",
           flush=True)
+    from . import trace
+
+    # fault coordinates may themselves be called `name`/`cat` (e.g.
+    # drop_control name=update) — remap those so they cannot collide
+    # with event()'s own parameters
+    args = {("fault_" + k if k in ("name", "cat") else k): v
+            for k, v in info.items()
+            if isinstance(v, (int, float, str, bool))}
+    trace.event(f"chaos.{ftype}", cat="chaos", **args)
 
 
 # -- hook points --------------------------------------------------------------
@@ -204,6 +219,12 @@ def on_step(rank: int, step: int) -> None:
         return
     sig = str(f.spec.get("signal", "KILL")).upper()
     _fire("crash_worker", rank=rank, step=step, signal=sig)
+    # flight-record the ring BEFORE the destructive action: a SIGKILL
+    # leaves no second chance, and the dump carries the chaos event
+    # _fire just emitted — the crash instant, from the victim itself
+    from . import trace
+
+    trace.flight_dump(reason=f"chaos-crash_worker-{sig}")
     if sig == "EXIT":
         os._exit(int(f.spec.get("code", 41)))
     os.kill(os.getpid(), getattr(signal, f"SIG{sig}", signal.SIGKILL))

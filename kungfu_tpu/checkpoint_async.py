@@ -87,9 +87,11 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from . import trace
 from .checkpoint import _path_str, fsync_dir as _fsync_dir
 from .env import env_float
 from .ops.collective import shard_schedule
+from .trace import metrics
 
 #: v2 added the mandatory per-piece `shared_sum` self-checksum — a v1
 #: generation is rejected as "unknown format" (restore falls back past
@@ -546,42 +548,47 @@ def write_generation(directory: str, gen: int, leaves: List,
     written: List[str] = []
     prev_hashes = prev_hashes or {}
     known_hashes = known_hashes or {}
-    for i in owned:
-        h = known_hashes.get(i)
-        if h is None or nbytes[i] <= ALWAYS_WRITE_BYTES:
-            h = _leaf_hash(view(i))
-        prev = prev_hashes.get(keys[i])
-        if prev is not None and prev[1] >= gen:
-            # re-writing an existing generation (a recovery redoing
-            # the step it lost): the chain entry points at the very
-            # bytes the os.replace below destroys, so honoring it
-            # would mark the leaf not-fresh while deleting its only
-            # copy — and GC could then drop the older generations
-            # that still hold real bytes. Force fresh. (save_sharded
-            # filters whole manifests with `g < gen`; this per-entry
-            # guard covers the async front end's live chain too.)
-            prev = None
-        fresh = (not incremental or prev is None or prev[0] != h
-                 or nbytes[i] <= ALWAYS_WRITE_BYTES)
-        entries[keys[i]] = {
-            "hash": h, "gen": gen if fresh else prev[1]}
-        if fresh:
-            written.append(keys[i])
-    written_set = set(written)
+    with trace.span("ckpt.hash", cat="ckpt", gen=gen):
+        for i in owned:
+            h = known_hashes.get(i)
+            if h is None or nbytes[i] <= ALWAYS_WRITE_BYTES:
+                h = _leaf_hash(view(i))
+            prev = prev_hashes.get(keys[i])
+            if prev is not None and prev[1] >= gen:
+                # re-writing an existing generation (a recovery
+                # redoing the step it lost): the chain entry points
+                # at the very bytes the os.replace below destroys, so
+                # honoring it would mark the leaf not-fresh while
+                # deleting its only copy — and GC could then drop the
+                # older generations that still hold real bytes. Force
+                # fresh. (save_sharded filters whole manifests with
+                # `g < gen`; this per-entry guard covers the async
+                # front end's live chain too.)
+                prev = None
+            fresh = (not incremental or prev is None or prev[0] != h
+                     or nbytes[i] <= ALWAYS_WRITE_BYTES)
+            entries[keys[i]] = {
+                "hash": h, "gen": gen if fresh else prev[1]}
+            if fresh:
+                written.append(keys[i])
+        written_set = set(written)
     t_hash = time.perf_counter()
 
     shard = _shard_path(gen_dir, rank)
     tmp = shard + ".tmp"
     shard_bytes = 0
-    with open(tmp, "wb") as f:
-        for spans in my_chunks:
-            for i, off, nb in spans:
-                if keys[i] in written_set:
-                    f.write(view(i)[off:off + nb])
-                    shard_bytes += nb
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, shard)
+    with trace.span("ckpt.write", cat="ckpt", gen=gen) as sp_write:
+        with open(tmp, "wb") as f:
+            for spans in my_chunks:
+                for i, off, nb in spans:
+                    if keys[i] in written_set:
+                        f.write(view(i)[off:off + nb])
+                        shard_bytes += nb
+            f.flush()
+            with trace.span("ckpt.fsync", cat="ckpt", gen=gen):
+                os.fsync(f.fileno())
+        os.replace(tmp, shard)
+        sp_write.set(bytes=shard_bytes)
 
     if residual is not None:
         payload: Dict[str, np.ndarray] = {
@@ -621,8 +628,9 @@ def write_generation(directory: str, gen: int, leaves: List,
     # load-time recomputation sees identical types (tuples -> lists)
     piece = json.loads(json.dumps(piece))
     piece["shared_sum"] = _shared_sum(piece)
-    _atomic_write(_manifest_path(gen_dir, rank),
-                  json.dumps(piece).encode())
+    with trace.span("ckpt.commit", cat="ckpt", gen=gen):
+        _atomic_write(_manifest_path(gen_dir, rank),
+                      json.dumps(piece).encode())
     t_done = time.perf_counter()
     return {
         "piece": piece,  # callers chain deltas without re-parsing it
@@ -1134,19 +1142,29 @@ class AsyncShardedCheckpointer:
         owned = self._owned_indices(keys, shapes, dtypes)
         leaves = jax.tree_util.tree_leaves(tree)
         snap: List = [None] * len(leaves)
-        for i in owned:
-            l = leaves[i]
-            if isinstance(l, np.ndarray):
-                snap[i] = l.copy()  # a trainer may mutate numpy in place
-            elif self.snapshot == "copy":
-                snap[i] = np.array(np.asarray(l), copy=True)
-            else:
-                snap[i] = l  # immutable: writer thread pays the D2H
+        # the only save work the TRAINING thread pays: reference
+        # capture / owned-numpy copies (everything else runs on the
+        # writer thread, as the ckpt.save span tree shows)
+        with trace.span("ckpt.snapshot", cat="ckpt", gen=int(step)):
+            for i in owned:
+                l = leaves[i]
+                if isinstance(l, np.ndarray):
+                    # a trainer may mutate numpy in place
+                    snap[i] = l.copy()
+                elif self.snapshot == "copy":
+                    snap[i] = np.array(np.asarray(l), copy=True)
+                else:
+                    snap[i] = l  # immutable: writer pays the D2H
         gen = int(step)
         self._sem.acquire()  # backpressure: double buffer only
         fut = self._pool.submit(self._job, gen, snap, keys, shapes,
                                 dtypes, step, meta, residual)
         self._pending.append(fut)
+        # /metrics backpressure depth: generations queued behind the
+        # double buffer right now (writer-thread lag indicator)
+        metrics.REGISTRY.set(
+            "kf_ckpt_pending",
+            sum(1 for f in self._pending if not f.done()))
         if block:
             self.wait()
         return gen
@@ -1155,6 +1173,8 @@ class AsyncShardedCheckpointer:
 
     def _job(self, gen, snap, keys, shapes, dtypes, step, meta,
              residual):
+        sp = trace.span("ckpt.save", cat="ckpt", gen=gen)
+        sp.__enter__()
         try:
             spec = (keys, shapes, dtypes)
             if self._chain_spec is not None \
@@ -1216,6 +1236,10 @@ class AsyncShardedCheckpointer:
             with self._mu:
                 self._errors.append(e)
         finally:
+            sp.__exit__(None, None, None)
+            metrics.REGISTRY.set(
+                "kf_ckpt_pending",
+                sum(1 for f in self._pending if not f.done()))
             self._sem.release()
 
     def _gc(self) -> None:

@@ -10,6 +10,16 @@ tests/go/cmd/kungfu-config-server-example/kungfu-config-server-example.go):
 - POST /clear         -> remove all workers (version++)
 - POST /reset         -> restore the initial seeded stage (version++)
 - GET  /stop          -> shut the server down
+- POST /trace         -> ingest one kftrace event batch (bounded)
+- GET  /trace         -> collected trace snapshot (JSON)
+
+The /trace pair is the kftrace collection rendezvous
+(docs/observability.md): workers' `TraceShipper`s POST bounded event
+batches here and `python -m kungfu_tpu.trace --server` merges the
+snapshot into a Perfetto trace. Trace traffic is observability-plane:
+it bypasses the chaos HTTP hooks (a fault schedule must perturb the
+CONTROL plane deterministically, not shift its request indices by
+however many trace batches happened to land first).
 
 Run standalone: `python -m kungfu_tpu.elastic.config_server --port 9100`.
 """
@@ -40,6 +50,10 @@ class ConfigServer:
         #: abruptly instead, so the host test survives
         self.standalone = standalone
         self._lock = threading.Lock()
+        # kftrace collection store (its own internal lock; bounded)
+        from ..trace.collect import TraceStore
+
+        self.trace_store = TraceStore()
         self._stage: Optional[Stage] = None  # kf: guarded_by(_lock)
         self._initial: Optional[Stage] = None  # kf: guarded_by(_lock)
         # kf: guarded_by(_lock)
@@ -133,6 +147,11 @@ class ConfigServer:
                 return False  # delay faults sleep inside the hook
 
             def do_GET(self):
+                if self.path.startswith("/trace"):
+                    # observability plane: no chaos hook (see module
+                    # docstring), no stage lock
+                    self._reply(200, server.trace_store.to_json())
+                    return
                 if self._chaos():
                     return
                 if self.path.startswith("/get"):
@@ -149,6 +168,18 @@ class ConfigServer:
                     self._reply(404, '{"error": "unknown path"}')
 
             def _do_update(self):
+                if self.path.startswith("/trace"):
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n).decode() if n else ""
+                    try:
+                        taken = server.trace_store.add_batch(
+                            json.loads(body))
+                    except (ValueError, KeyError, TypeError) as e:
+                        self._reply(400,
+                                    json.dumps({"error": str(e)}))
+                        return
+                    self._reply(200, json.dumps({"accepted": taken}))
+                    return
                 if self._chaos():
                     return
                 n = int(self.headers.get("Content-Length", 0))

@@ -53,7 +53,9 @@ import numpy as np
 import optax
 
 import kungfu_tpu
+from kungfu_tpu import trace
 from kungfu_tpu.data import ElasticSampler
+from kungfu_tpu.trace import metrics
 from kungfu_tpu.datasets import load_synthetic_split
 from kungfu_tpu.elastic import ElasticCallback
 from kungfu_tpu.ffi import KfError
@@ -276,19 +278,28 @@ def try_recover():
 last_loss = None
 pending_continuity = None  # survivor's pre-resize/pre-recovery loss
 while elastic.state.step < TOTAL_STEPS:
+    t_step0 = time.perf_counter()
     idx = sampler.next_indices()
     batch = {"x": x[idx], "y": y[idx]}
-    loss, grads = loss_and_grads(params, batch)
-    loss = float(loss)
+    # the three structured train-step phases (docs/observability.md):
+    # compute (jitted fwd/bwd incl. the host sync that materializes
+    # the loss), grad-wire (the DCN all-reduce — lump or bucketed
+    # pipeline), hook (schedule/consensus poll). Spans wrap the CALL
+    # SITES; nothing records inside the jitted body (the trace-purity
+    # lint holds the whole tree to that).
+    with trace.span("step.compute", cat="step"):
+        loss, grads = loss_and_grads(params, batch)
+        loss = float(loss)
     try:
-        if pipe is not None:
-            # the agreed step tags the wire names: a replacement
-            # joiner's fresh pipe must align with survivors' pipes
-            grads = pipe.all_reduce(grads, step=elastic.state.step)
-        else:
-            buf = peer.all_reduce(
-                np.asarray(fuse(grads)),
-                name=f"g:{peer.version}:{elastic.state.step}")
+        with trace.span("step.grad_wire", cat="step"):
+            if pipe is not None:
+                # the agreed step tags the wire names: a replacement
+                # joiner's fresh pipe must align with survivors' pipes
+                grads = pipe.all_reduce(grads, step=elastic.state.step)
+            else:
+                buf = peer.all_reduce(
+                    np.asarray(fuse(grads)),
+                    name=f"g:{peer.version}:{elastic.state.step}")
     except KfError:
         if not RECOVER:
             raise
@@ -299,6 +310,7 @@ while elastic.state.step < TOTAL_STEPS:
         # this closes the MTTR window the recovery benchmark measures
         print(f"KF_MTTR resumed t={time.time() * 1e3:.1f} "
               f"rank={peer.rank} step={elastic.state.step}", flush=True)
+        trace.event("recovery.resume", cat="recovery")
         just_recovered = False
     if pipe is None:
         grads = defuse(jnp.asarray(buf) / peer.size, grads)
@@ -316,7 +328,8 @@ while elastic.state.step < TOTAL_STEPS:
     last_loss = loss
 
     try:
-        changed = elastic.after_step()
+        with trace.span("step.hook", cat="step"):
+            changed = elastic.after_step()
     except KfError:
         # a peer died inside the resize consensus round (or the chaos
         # victim was *us* and this line never returns)
@@ -336,6 +349,10 @@ while elastic.state.step < TOTAL_STEPS:
         print(f"resized: epoch {peer.version} size={peer.size} "
               f"step={elastic.state.step}", flush=True)
     maybe_save()
+    # the /metrics step-latency histogram (kf_step_latency_ms) — the
+    # headline family an operator watches for stalls
+    metrics.REGISTRY.observe("kf_step_latency_ms",
+                             (time.perf_counter() - t_step0) * 1e3)
 
 if ckpt is not None:
     ckpt.close()  # drain pending async generations before exit

@@ -20,7 +20,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from .. import chaos
+from .. import chaos, trace
 from ..ops.collective import pack_bytes, unpack_bytes
 from ..peer import Peer
 from .schedule import step_based_schedule
@@ -82,6 +82,10 @@ class ElasticCallback:
         st = self.state
         st.step += 1
         st.trained_samples += self.samples_per_step * self.peer.size
+        # the SPMD trace context follows the cluster-agreed counters:
+        # every event this process emits from here on is attributed to
+        # the step that is actually running
+        trace.set_context(step=st.step, version=self.peer.version)
         # deterministic fault injection: a scheduled crash_worker fault
         # for (rank, step) fires here, so chaos tests drive the SAME
         # step boundary production failures hit (kungfu_tpu/chaos.py)
@@ -116,6 +120,12 @@ class ElasticCallback:
                 )
         changed, keep = self.peer.resize_from_url(self.config_server)
         st.changed, st.keep = changed, keep
+        if changed:
+            # rank/version may both have moved with the new epoch
+            trace.set_context(rank=self.peer.rank,
+                              version=self.peer.version)
+            trace.event("resize.adopted", cat="elastic",
+                        size=self.peer.size, keep=keep)
         return changed
 
     # -- survivor-driven failure recovery ------------------------------------
@@ -137,8 +147,17 @@ class ElasticCallback:
         t0 = time.time()
         print(f"KF_MTTR error t={t0 * 1e3:.1f} rank={self.peer.rank} "
               f"epoch={self.peer.version}", flush=True)
-        recovered, keep = self.peer.recover_from_url(
-            self.config_server, deadline_s=deadline_s)
+        # flight-record the ring NOW: the epoch that just failed is
+        # about to be torn down, and if recovery itself dies this is
+        # the only record of what the step was doing when the peer
+        # vanished (docs/observability.md, flight-recorder lifecycle)
+        trace.event("recovery.caught", cat="recovery",
+                    epoch=self.peer.version)
+        trace.flight_dump(reason="recovery")
+        with trace.span("recovery.adopt", cat="recovery") as sp:
+            recovered, keep = self.peer.recover_from_url(
+                self.config_server, deadline_s=deadline_s)
+            sp.set(recovered=recovered, keep=keep)
         if not recovered or not keep:
             # state.keep lets the caller tell a legitimate eviction
             # (exit 0, like the planned-resize path) from a recovery
@@ -151,10 +170,16 @@ class ElasticCallback:
         print(f"KF_MTTR adopted t={t1 * 1e3:.1f} rank={self.peer.rank} "
               f"epoch={self.peer.version} size={self.peer.size}",
               flush=True)
-        if params is not None:
-            params = self.resync_params(params)
-        else:
-            self.sync_position()
+        # the recovered epoch is live: re-bind the trace context
+        # before the restore collectives emit under it
+        trace.set_context(rank=self.peer.rank,
+                          version=self.peer.version)
+        with trace.span("recovery.restore", cat="recovery",
+                        size=self.peer.size):
+            if params is not None:
+                params = self.resync_params(params)
+            else:
+                self.sync_position()
         t2 = time.time()
         print(f"KF_MTTR restored t={t2 * 1e3:.1f} rank={self.peer.rank} "
               f"adopt_ms={(t1 - t0) * 1e3:.1f} "
@@ -207,37 +232,48 @@ class ElasticCallback:
 
         t0 = time.perf_counter()
         chunk_bytes = stream_chunk_bytes(chunk_mb)
-        if chunk_bytes > 0:
-            out, phases = stream_broadcast(
-                self.peer, params, root=root, chunk_bytes=chunk_bytes,
-                name="kf::elastic::model")
+        # one structured span per state resync; its args carry the
+        # SAME phase decomposition last_resize_timings publishes (plus
+        # the new cluster size), so the adaptation benchmark can read
+        # resizes out of the trace instead of scraping worker stdout
+        with trace.span("resize.resync", cat="elastic",
+                        size=self.peer.size) as sp:
+            if chunk_bytes > 0:
+                out, phases = stream_broadcast(
+                    self.peer, params, root=root,
+                    chunk_bytes=chunk_bytes,
+                    name="kf::elastic::model")
+                t_bcast = time.perf_counter()
+                self.sync_position()
+                t_pos = time.perf_counter()
+                self.last_resize_timings = {
+                    **self.peer.last_resize_phases,
+                    "pack_ms": phases["pack_ms"],
+                    "broadcast_ms": phases["broadcast_ms"],
+                    "overlap_ms": phases["overlap_ms"],
+                    "stream_wall_ms": phases["wall_ms"],
+                    "stream_chunks": phases["chunks"],
+                    "position_ms": (t_pos - t_bcast) * 1e3,
+                }
+                sp.set(**{k: round(v, 3) if isinstance(v, float) else v
+                          for k, v in self.last_resize_timings.items()})
+                return out
+            packed = pack_bytes(params)
+            t_pack = time.perf_counter()
+            synced = self.peer.broadcast(packed, root=root,
+                                         name="kf::elastic::model")
             t_bcast = time.perf_counter()
             self.sync_position()
             t_pos = time.perf_counter()
             self.last_resize_timings = {
                 **self.peer.last_resize_phases,
-                "pack_ms": phases["pack_ms"],
-                "broadcast_ms": phases["broadcast_ms"],
-                "overlap_ms": phases["overlap_ms"],
-                "stream_wall_ms": phases["wall_ms"],
-                "stream_chunks": phases["chunks"],
+                "pack_ms": (t_pack - t0) * 1e3,
+                "broadcast_ms": (t_bcast - t_pack) * 1e3,
                 "position_ms": (t_pos - t_bcast) * 1e3,
             }
-            return out
-        packed = pack_bytes(params)
-        t_pack = time.perf_counter()
-        synced = self.peer.broadcast(packed, root=root,
-                                     name="kf::elastic::model")
-        t_bcast = time.perf_counter()
-        self.sync_position()
-        t_pos = time.perf_counter()
-        self.last_resize_timings = {
-            **self.peer.last_resize_phases,
-            "pack_ms": (t_pack - t0) * 1e3,
-            "broadcast_ms": (t_bcast - t_pack) * 1e3,
-            "position_ms": (t_pos - t_bcast) * 1e3,
-        }
-        return unpack_bytes(synced, params)
+            sp.set(**{k: round(v, 3) if isinstance(v, float) else v
+                      for k, v in self.last_resize_timings.items()})
+            return unpack_bytes(synced, params)
 
 
 def shard_offset(

@@ -45,8 +45,10 @@ from typing import Tuple
 
 import numpy as np
 
+from .. import trace
 from ..env import env_float
 from ..ops.collective import chunk_schedule, leaf_byte_views
+from ..trace import metrics
 
 #: default streaming chunk size (MiB). Small enough that the tail
 #: scratch is noise next to the model, large enough that per-chunk
@@ -150,7 +152,14 @@ def stream_broadcast(peer, tree, root: int = 0,
 
     def wire(buf, cname):
         t0 = time.perf_counter()
-        peer.broadcast_inplace(buf, root=root, name=cname)
+        # per-chunk resync span (executor thread): the pipelined wire
+        # ops render as a train of resync.chunk spans overlapping the
+        # main thread's pack work in the Perfetto view
+        with trace.span("resync.chunk", cat="elastic", chunk=cname,
+                        bytes=int(buf.nbytes)):
+            peer.broadcast_inplace(buf, root=root, name=cname)
+        metrics.REGISTRY.inc("kf_wire_bytes_total", int(buf.nbytes),
+                             collective="resync")
         t_bcast[0] += time.perf_counter() - t0
 
     def scatter(scratch, spans):

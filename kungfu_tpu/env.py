@@ -50,6 +50,14 @@ CONFIG_VARS = (
     "KF_CKPT_DIR",
     "KF_CKPT_EVERY",
     "KF_CKPT_CHUNK_MB",
+    # kftrace structured tracing + flight recorder
+    # (docs/observability.md): KF_TRACE enables both the native scope
+    # counters and the kftrace recorder; KF_TRACE_DIR arms flight
+    # dumps; ring capacity and shipper period are tuning knobs
+    "KF_TRACE",
+    "KF_TRACE_DIR",
+    "KF_TRACE_RING",
+    "KF_TRACE_POST_MS",
 )
 
 ALL_BOOTSTRAP_VARS = (

@@ -62,9 +62,11 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import trace
 from .env import env_choice, env_float
 from .ffi import KfError, OrderGroup
 from .ops.collective import bucket_schedule
+from .trace import metrics
 
 #: default bucket size (MiB). The native layer re-chunks to 1 MiB for
 #: the wire, so larger buckets only delay the first launch; 1 MiB
@@ -306,16 +308,20 @@ class GradBucketPipeline:
             _, spans = self._schedule[k]
             nm = f"{tag}:b{k}"
             try:
-                bufs = [fetch(i)[o:o + n] for i, o, n in spans]
-                # the _round fallback inside `tag` is for STATIC
-                # clusters only, where the internal counter advances
-                # identically on every rank; elastic callers must pass
-                # the cluster-agreed step= (all_reduce docstring; the
-                # PR 5 joiner deadlock in docs/static_analysis.md is
-                # what happens otherwise, and what kfverify flags here)
-                # kflint: disable=wire-name-determinism
-                slot = self._make_slot(k, bufs, nm, wire_bytes,
-                                       wire_clock)
+                with trace.span("bucket.pack", cat="grad", bucket=k):
+                    bufs = [fetch(i)[o:o + n] for i, o, n in spans]
+                    # the _round fallback inside `tag` is for STATIC
+                    # clusters only, where the internal counter
+                    # advances identically on every rank; elastic
+                    # callers must pass the cluster-agreed step=
+                    # (all_reduce docstring; the PR 5 joiner deadlock
+                    # in docs/static_analysis.md is what happens
+                    # otherwise, and what kfverify flags here)
+                    # kflint: disable=wire-name-determinism
+                    slot = self._make_slot(k, bufs, nm, wire_bytes,
+                                           wire_clock)
+                if trace.enabled():
+                    slot = self._traced_slot(k, slot)
             # a pack failure must not wedge THIS rank: register a no-op
             # slot so the local wait() completes and the error surfaces
             # (peers fail fast on their own collective timeout, exactly
@@ -355,7 +361,8 @@ class GradBucketPipeline:
                 "gradient-pipeline pack failed: "
                 + "; ".join(f"{n}: {e}" for n, e in errors))
 
-        out = self._land(leaves, flats, size if average else 1)
+        with trace.span("bucket.land", cat="grad"):
+            out = self._land(leaves, flats, size if average else 1)
         wall = time.perf_counter() - t0
         self.last_step_info = {
             "buckets": len(self._schedule),
@@ -365,9 +372,26 @@ class GradBucketPipeline:
             "wall_ms": wall * 1e3,
             "arrival": arrival,
         }
+        # /metrics families (docs/observability.md): cumulative wire
+        # payload, and how long the wire executor idled waiting on
+        # packer arrivals (wall - wire) — the backpressure signal an
+        # adaptive bucket scheduler would consume
+        metrics.REGISTRY.inc("kf_wire_bytes_total", wire_bytes[0],
+                             collective="grad")
+        metrics.REGISTRY.set("kf_grad_arrival_lag_ms",
+                             max(0.0, (wall - t_wire[0]) * 1e3))
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
     # -- wire slots (run on the OrderGroup executor, schedule order) ---------
+
+    @staticmethod
+    def _traced_slot(k, slot):
+        """Wrap a wire slot in a bucket.wire span (executor thread)."""
+        def traced():
+            with trace.span("bucket.wire", cat="grad", bucket=k):
+                slot()
+
+        return traced
 
     def _make_slot(self, k, bufs, nm, wire_bytes, wire_clock):
         peer = self.peer

@@ -35,20 +35,28 @@ class MetricsServer:
         self._rates = (0.0, 0.0)  # kf: guarded_by(_lock)
 
     def _sample(self):
-        stats = self._peer.stats()
-        now = time.monotonic()
+        """Advance the rate window and return ONE consistent
+        ``(stats, (egress_rate, ingress_rate))`` pair, computed and
+        read under the same lock acquisition. Both the tick thread and
+        every /metrics handler thread land here; returning rates from
+        a second lock acquisition (the pre-round-10 shape) let another
+        thread's sample slip between the two, pairing this scrape's
+        totals with a different window's rates."""
         with self._lock:
+            # the stats read sits INSIDE the lock too: two samplers
+            # interleaving an outside read could record the newer
+            # totals first and hand the older sampler a negative rate
+            stats = self._peer.stats()
+            now = time.monotonic()
             t0, eg0, in0 = self._last
             dt = max(now - t0, 1e-9)
             self._rates = ((stats["egress_bytes"] - eg0) / dt,
                            (stats["ingress_bytes"] - in0) / dt)
             self._last = (now, stats["egress_bytes"], stats["ingress_bytes"])
-        return stats
+            return stats, self._rates
 
     def render(self) -> str:
-        stats = self._sample()
-        with self._lock:
-            eg_rate, in_rate = self._rates
+        stats, (eg_rate, in_rate) = self._sample()
         rank = self._peer.rank
         lines = [
             f'kf_egress_bytes_total{{rank="{rank}"}} {stats["egress_bytes"]}',
@@ -66,6 +74,16 @@ class MetricsServer:
                 f"kf_trace_total_us{tags} {c['total_us']}",
                 f"kf_trace_max_us{tags} {c['max_us']}",
             ]
+        # the unified metrics plane (docs/observability.md): step
+        # latency histograms, per-collective wire bytes, queue depths
+        # — whatever the runtime components registered this process
+        from . import trace as kftrace
+        from .trace.metrics import REGISTRY
+
+        if kftrace.enabled():
+            REGISTRY.set("kf_trace_dropped_events",
+                         kftrace.recorder().dropped_events)
+        lines += REGISTRY.render(extra_labels={"rank": str(rank)})
         return "\n".join(lines) + "\n"
 
     def start(self) -> "MetricsServer":

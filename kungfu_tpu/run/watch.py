@@ -29,6 +29,7 @@ import subprocess
 import time
 from typing import Dict, List, Optional
 
+from .. import trace
 from ..ffi import NativePeer
 from ..peer import Stage, fetch_url, put_url
 from ..plan import Cluster, PeerID, PeerList
@@ -129,6 +130,10 @@ class Watcher:
         self.current_version = -1
         self.control = NativePeer(str(runner_id), "", version=0)
         self.control.set_control_handler(self._on_control)
+        # the runner is the failure DETECTOR: its detect/propose events
+        # open the structured MTTR timeline every worker's flight
+        # records close (docs/observability.md)
+        trace.install(role="runner")
 
     # -- control channel ----------------------------------------------------
 
@@ -244,6 +249,8 @@ class Watcher:
             f"peer={dead} code={code}",
             flush=True,
         )
+        trace.event("recovery.detect", cat="recovery",
+                    dead_rank=proc.rank, code=code)
         # The runner's whole propose window must END before the
         # survivors' recovery polls give up (KF_RECOVERY_DEADLINE_MS,
         # default 30 s) — a proposal landing after the survivors exited
@@ -327,6 +334,9 @@ class Watcher:
             f"recovery={self.recoveries}/{self.recovery_budget}",
             flush=True,
         )
+        trace.event("recovery.propose", cat="recovery",
+                    stage_version=shrunken.version,
+                    survivors=len(self.procs))
         return True
 
     def run(self, initial: Optional[Stage]) -> int:

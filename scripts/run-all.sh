@@ -104,6 +104,27 @@ with tempfile.TemporaryDirectory() as d:
 print("CHECKPOINT SMOKE OK")
 EOF
 
+echo "== [4d/7] kftrace smoke: 2-peer traced resize -> Chrome trace validates =="
+# the observability plane (docs/observability.md): a traced elastic
+# run must flight-dump per-rank JSONL, the exporter must merge it into
+# Chrome trace JSON, and the validator must accept it (loads, required
+# keys, spans nest within their track) — malformed output fails here
+timeout 300 python - <<'EOF'
+import os, subprocess, sys, tempfile
+d = tempfile.mkdtemp(prefix="kf-trace-smoke-")
+os.environ["KF_TRACE"] = "1"
+os.environ["KF_TRACE_DIR"] = d
+from kungfu_tpu.elastic.harness import run_loss_continuity
+run_loss_continuity(schedule="4:2,4:3", total_steps=9, start_np=2,
+                    port_range="26000-26999", timeout=240)
+out = os.path.join(d, "trace.json")
+for args in (["--dir", d, "-o", out], ["--validate", out]):
+    r = subprocess.run([sys.executable, "-m", "kungfu_tpu.trace"] + args)
+    if r.returncode:
+        sys.exit(f"kftrace smoke failed at {' '.join(args)}")
+print("KFTRACE SMOKE OK")
+EOF
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

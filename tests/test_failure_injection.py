@@ -107,19 +107,28 @@ def test_stale_epoch_sender_rejected():
 
 
 @pytest.mark.chaos
-def test_survivor_recovery_after_chaos_worker_kill():
+def test_survivor_recovery_after_chaos_worker_kill(tmp_path):
     """THE acceptance scenario: a worker SIGKILLed mid-training via a
     chaos schedule => surviving workers shrink membership, restore
     state, continue training with loss continuity asserted, and the
     schedule even re-grows the cluster back to target size through the
     normal elastic path — all with zero operator action. Every phase of
     the recovery pipeline is asserted marker-by-marker
-    (harness.RECOVERY_MARKERS)."""
+    (harness.RECOVERY_MARKERS) — and, since round 11, span-by-span:
+    the run flight-records under KF_TRACE and the kftrace structured
+    MTTR decomposition must AGREE with the stdout-marker one
+    (docs/observability.md)."""
+    from kungfu_tpu.benchmarks.recovery import (check_agreement,
+                                                decompose,
+                                                decompose_events)
     from kungfu_tpu.elastic.harness import run_survivor_recovery
 
+    trace_dir = str(tmp_path / "kftrace")
     logs = run_survivor_recovery(crash_rank=1, crash_step=5,
                                  total_steps=12, start_np=3,
-                                 port_range="27100-27999", timeout=300)
+                                 port_range="27100-27999", timeout=300,
+                                 extra_env={"KF_TRACE": "1",
+                                            "KF_TRACE_DIR": trace_dir})
     # the recovery epoch ran at the shrunken size...
     assert "KF_RECOVERY_DONE rank=0 size=2" in logs, logs[-3000:]
     # ...and the schedule healed the cluster back to 3 afterwards: the
@@ -127,6 +136,16 @@ def test_survivor_recovery_after_chaos_worker_kill():
     # completed at full size
     assert "KF_JOINER_CONTINUITY" in logs, logs[-3000:]
     assert "size=3 step=12" in logs, logs[-3000:]
+    # the two MTTR decompositions — stdout markers vs the kftrace
+    # flight-recorder span tree (chaos victim's own crash record,
+    # runner detect/propose, survivor adopt/restore/resume) — must
+    # both be complete and reconcile
+    d_markers = decompose(logs)
+    d_events = decompose_events(trace_dir)
+    assert d_markers is not None, logs[-3000:]
+    assert d_events is not None, "structured MTTR timeline incomplete"
+    disagreements = check_agreement(d_markers, d_events)
+    assert not disagreements, disagreements
 
 
 @pytest.mark.chaos

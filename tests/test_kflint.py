@@ -332,6 +332,45 @@ def test_trace_fires_on_branching_on_tracer():
     assert "branching" in findings[0].message
 
 
+def test_trace_fires_on_recorder_call_in_jit_body():
+    # PR 11's rule: kftrace recorder calls inside a compiled body
+    # record at trace time (and would bake frozen wall clocks into the
+    # program) — instrumentation wraps the call site only
+    findings = fire(TracePurityPass(), """
+        import jax
+        from kungfu_tpu import trace
+
+        @jax.jit
+        def step(params, batch):
+            with trace.span("step.compute", cat="step"):
+                loss = (params * batch).sum()
+            trace.event("step.done")
+            return loss
+    """)
+    assert len(findings) == 2, findings
+    assert all("kftrace recorder" in f.message for f in findings)
+    msgs = " ".join(f.message for f in findings)
+    assert "trace.span" in msgs and "trace.event" in msgs
+
+
+def test_trace_quiet_on_recorder_at_call_site():
+    findings = fire(TracePurityPass(), """
+        import jax
+        from kungfu_tpu import trace
+
+        @jax.jit
+        def step(params, batch):
+            return (params * batch).sum()
+
+        def train_loop(params, batch):
+            with trace.span("step.compute", cat="step"):
+                loss = step(params, batch)
+            trace.event("step.done")
+            return loss
+    """)
+    assert findings == []
+
+
 def test_trace_quiet_on_static_metadata_and_statics():
     findings = fire(TracePurityPass(), """
         import functools

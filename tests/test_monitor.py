@@ -1,0 +1,143 @@
+"""MetricsServer: race-free sampling + the unified metrics plane.
+
+The round-11 satellite: `/metrics` renders under a SINGLE `_sample()`
+snapshot — the tick thread and every scrape-handler thread both
+advance the rate window, and the pre-round-11 shape (sample, release
+the lock, re-acquire to read `_rates`) let another thread's sample
+slip in between, pairing one window's totals with a different
+window's rates. These tests pin the pairing and hammer the two
+mutation paths concurrently against a live HTTP endpoint.
+"""
+
+import threading
+import urllib.request
+
+import pytest
+
+from kungfu_tpu.monitor import MetricsServer
+from kungfu_tpu.trace.metrics import REGISTRY
+
+
+class FakePeer:
+    """stats() counts calls; values strictly increase per call so any
+    torn stats/rates pairing is observable as a negative rate."""
+
+    rank = 3
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._calls = 0  # kf: guarded_by(_mu)
+
+    def stats(self):
+        with self._mu:
+            self._calls += 1
+            n = self._calls
+        return {"egress_bytes": n * 1000, "ingress_bytes": n * 100}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+def test_sample_returns_one_consistent_pair():
+    srv = MetricsServer(FakePeer(), port=0)
+    stats1, rates1 = srv._sample()
+    stats2, rates2 = srv._sample()
+    # the returned rates were computed FROM the returned stats against
+    # the previous window — strictly increasing counters make them
+    # strictly positive, and the stats totals advance monotonically
+    assert stats2["egress_bytes"] == stats1["egress_bytes"] + 1000
+    assert rates2[0] > 0 and rates2[1] > 0
+
+
+def test_render_includes_registry_families():
+    REGISTRY.observe("kf_step_latency_ms", 12.0)
+    REGISTRY.inc("kf_wire_bytes_total", 4096, collective="grad")
+    REGISTRY.set("kf_ckpt_pending", 1)
+    srv = MetricsServer(FakePeer(), port=0)
+    text = srv.render()
+    assert 'kf_egress_bytes_total{rank="3"}' in text
+    assert 'kf_wire_bytes_total{collective="grad",rank="3"} 4096' \
+        in text
+    assert 'kf_step_latency_ms_count{rank="3"} 1' in text
+    assert 'kf_ckpt_pending{rank="3"} 1' in text
+
+
+def test_concurrent_scrape_and_tick_thread_sampling():
+    """The regression: N scrape threads hammering render() while the
+    tick path calls _sample() — both mutate `_last`. Every rendered
+    exposition must be internally consistent: totals parse, rates are
+    non-negative (strictly-increasing fake counters: a negative rate
+    means a scrape paired its totals with a window sampled by another
+    thread), and totals never regress across sequential scrapes."""
+    srv = MetricsServer(FakePeer(), port=0)
+    errors = []
+    seen = {"egress": []}
+    mu = threading.Lock()
+
+    def parse(text, family):
+        for line in text.splitlines():
+            if line.startswith(family + "{"):
+                return float(line.rsplit(" ", 1)[1])
+        raise AssertionError(f"{family} missing:\n{text}")
+
+    def scrape():
+        try:
+            prev = -1.0
+            for _ in range(200):
+                text = srv.render()
+                total = parse(text, "kf_egress_bytes_total")
+                rate = parse(text, "kf_egress_bytes_per_sec")
+                assert rate >= 0, f"negative rate {rate}"
+                assert total > prev, "totals regressed"
+                prev = total
+                with mu:
+                    seen["egress"].append(total)
+        except BaseException as e:  # noqa: BLE001 — re-raised by main
+            errors.append(e)
+
+    def tick():
+        try:
+            for _ in range(400):
+                srv._sample()
+        except BaseException as e:  # noqa: BLE001 — re-raised by main
+            errors.append(e)
+
+    threads = [threading.Thread(target=scrape) for _ in range(4)]
+    threads.append(threading.Thread(target=tick))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    assert len(seen["egress"]) == 800
+
+
+def test_http_scrape_under_concurrency():
+    srv = MetricsServer(FakePeer(), port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        errors = []
+
+        def hit():
+            try:
+                for _ in range(20):
+                    with urllib.request.urlopen(url, timeout=10) as r:
+                        body = r.read().decode()
+                    assert "kf_egress_bytes_total" in body
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=hit) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errors:
+            raise errors[0]
+    finally:
+        srv.stop()
