@@ -33,6 +33,15 @@ guesses):
   ``in_specs`` check, and a **string literal** bound to the
   conventional ``axis_name=`` keyword is checked against the declared
   axes exactly like a literal inside the body.
+- **rules-backed declared axes (specs-as-data)**: since kfspec, most
+  modules build their specs from ``parallel/rules.py`` helpers
+  (``stacked("data")`` — the literal argument declares the axis, the
+  generic literal walk already sees it) or from a rules TABLE
+  (``gpt_tp_rules()``), whose axis universe the pass resolves from
+  the live engine registry (``rules.TABLE_AXES``) instead of
+  re-deriving it from shard_map literals. The literal path stays as
+  fallback: a table call with explicit axis arguments contributes
+  those even when the engine is not importable.
 """
 
 from __future__ import annotations
@@ -110,6 +119,32 @@ def _mesh_axis_literals(tree: ast.AST) -> Set[str]:
     return out
 
 
+def _rules_table_axes(tree: ast.AST) -> Set[str]:
+    """Axes declared by kfspec rules-table constructor calls
+    (specs-as-data): a module deriving its layout from
+    ``gpt_tp_rules()`` declares that table's axis universe without
+    re-stating it as string literals. Default axes resolve from the
+    LIVE engine registry (``parallel.rules.TABLE_AXES`` — the tables
+    are data, so the pass reads the data); literal axis arguments
+    contribute regardless, which keeps the literal path as fallback
+    when the engine is not importable (fixture runs outside the
+    repo)."""
+    calls = [n for n in ast.walk(tree)
+             if isinstance(n, ast.Call)
+             and (_tail(call_name(n)) or "").endswith("_rules")]
+    if not calls:
+        return set()
+    try:
+        from ..parallel.rules import TABLE_AXES
+    except ImportError:
+        TABLE_AXES = {}
+    out: Set[str] = set()
+    for node in calls:
+        out.update(literal_strings(node))
+        out.update(TABLE_AXES.get(_tail(call_name(node)), ()))
+    return out
+
+
 def _resolve_axis(node: ast.AST, consts: Dict[str, Optional[str]],
                   ) -> Optional[str]:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
@@ -175,7 +210,8 @@ class AxisConsistencyPass:
 
     def run(self, src: Source) -> List[Finding]:
         findings: List[Finding] = []
-        mesh_axes = _mesh_axis_literals(src.tree)
+        mesh_axes = (_mesh_axis_literals(src.tree)
+                     | _rules_table_axes(src.tree))
         consts_v = _ConstStrings()
         consts_v.visit(src.tree)
         consts = consts_v.values

@@ -146,26 +146,39 @@ def _comment_lines(text: str, lines: List[str]):
         return list(enumerate(lines, start=1))
 
 
-def all_passes() -> list:
-    # imported lazily so `import kungfu_tpu.analysis` stays cheap and
-    # dependency-light (vmem-budget pulls in jax only when it RUNS)
-    from . import (axis_consistency, lock_discipline, retry_discipline,
-                   trace_purity, unused_imports, vmem_budget)
-    from .protocol import (CollectiveOrderPass, LockOrderPass,
-                           SchedulePurityPass, WireNameDeterminismPass)
+#: THE pass registry — the one list the CLI (`--list`, `--select`,
+#: `--baseline`), `run_paths` and the fixture suite all derive from.
+#: Adding a pass means adding one row here; there is no second list to
+#: forget (the old split between this module and the test loader let a
+#: pass exist without its CLI/baseline wiring). Each row is
+#: (submodule, class name), imported lazily so `import
+#: kungfu_tpu.analysis` stays cheap and dependency-light (vmem-budget
+#: and the shard-rule passes pull in jax only when they RUN).
+PASS_SPECS = (
+    ("retry_discipline", "RetryDisciplinePass"),
+    ("axis_consistency", "AxisConsistencyPass"),
+    ("trace_purity", "TracePurityPass"),
+    ("lock_discipline", "LockDisciplinePass"),
+    ("unused_imports", "UnusedImportsPass"),
+    ("vmem_budget", "VmemBudgetPass"),
+    ("shard_rules", "HandRolledSpecPass"),
+    ("shard_rules", "RuleCoveragePass"),
+    ("shard_rules", "MeshValidityPass"),
+    ("protocol.wire_names", "WireNameDeterminismPass"),
+    ("protocol.collective_order", "CollectiveOrderPass"),
+    ("protocol.schedule_purity", "SchedulePurityPass"),
+    ("protocol.lock_order", "LockOrderPass"),
+)
 
-    return [
-        retry_discipline.RetryDisciplinePass(),
-        axis_consistency.AxisConsistencyPass(),
-        trace_purity.TracePurityPass(),
-        lock_discipline.LockDisciplinePass(),
-        unused_imports.UnusedImportsPass(),
-        vmem_budget.VmemBudgetPass(),
-        WireNameDeterminismPass(),
-        CollectiveOrderPass(),
-        SchedulePurityPass(),
-        LockOrderPass(),
-    ]
+
+def all_passes() -> list:
+    import importlib
+
+    out = []
+    for submodule, cls in PASS_SPECS:
+        mod = importlib.import_module(f".{submodule}", __package__)
+        out.append(getattr(mod, cls)())
+    return out
 
 
 def _selected(passes, select: Optional[Sequence[str]]):

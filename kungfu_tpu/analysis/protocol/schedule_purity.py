@@ -45,8 +45,21 @@ from .project import (CLOCK_CALLS, ENV_CALLS, RNG_CALLS, FuncInfo,
 
 NAME = "schedule-purity"
 
+#: `match_partition_rules` joins the original three: a sharding plan
+#: is a schedule — every rank must statically derive the identical
+#: spec tree from shapes/paths alone (parallel/rules.py, kfspec), the
+#: same discipline chunk/bucket/shard layouts already obey. Rules-
+#: table constructors (the `*_rules` convention) are checked as
+#: schedule bodies too, below.
 SCHEDULE_FUNCS = {"chunk_schedule", "bucket_schedule",
-                  "shard_schedule"}
+                  "shard_schedule", "match_partition_rules"}
+
+
+def _is_rules_table_fn(name: str) -> bool:
+    """The kfspec table-constructor convention: any `*_rules` function
+    IS a rules table and must be shape-only (a value/env read inside
+    one would poison every plan derived from it)."""
+    return name.endswith("_rules") and not name.startswith("_")
 
 _VALUE_METHODS = {"item", "tolist", "any", "all", "nonzero", "argmax",
                   "argmin"}
@@ -150,6 +163,19 @@ class SchedulePurityPass:
                            f"{what} inside {fname}() — the schedule "
                            "must derive from shapes/dtypes only, or "
                            "every caller's ranks diverge")
+
+        # rules-table constructors (`*_rules`): a table is plan data —
+        # every rank must build the identical one (kfspec discipline)
+        for fname in sorted(index.by_simple):
+            if not _is_rules_table_fn(fname):
+                continue
+            for info in index.by_simple.get(fname, ()):
+                for line, what in _violations(info.node):
+                    report(info.src, line,
+                           f"{what} inside rules table {fname}() — "
+                           "sharding tables are schedule data; every "
+                           "rank must derive the identical plan from "
+                           "shapes/paths alone")
 
         # call sites: the functions feeding the arguments
         for attr in sorted(SCHEDULE_FUNCS):

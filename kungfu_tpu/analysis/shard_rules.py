@@ -1,0 +1,250 @@
+"""shard-rules passes: sharding specs must be declarative, total, valid.
+
+The kfspec engine (``parallel/rules.py``) turned PartitionSpecs from
+code into data — ordered ``(path regex, spec)`` tables per model
+family, registered with the model trees and mesh shapes they serve.
+Three passes make that discipline enforceable, extending kflint from
+protocol correctness (PR 4/6) to sharding correctness:
+
+- ``shard-rules`` (per-file): literal ``PartitionSpec(...)``
+  construction anywhere outside ``parallel/rules.py`` flags. A
+  hand-rolled spec is exactly how the ``fused=(n == 1)``
+  silent-degradation class regrew per composition: a layout decision
+  the static passes cannot see. Suppression requires a written
+  reason like every kflint disable.
+- ``shard-rule-coverage`` (whole-tree): every leaf path of every
+  registered model template must match a rule (tables are total), and
+  every rule must win on at least one leaf — a rule that never fires
+  is either DEAD (nothing matches its pattern: a path typo, or the
+  model renamed a module and the split silently vanished — the
+  sharding sibling of the fused-CE fallback) or SHADOWED (an earlier
+  rule claims every leaf it would match: ordering bug).
+- ``shard-rule-mesh`` (whole-tree): every table instantiates cleanly
+  on every mesh shape it declares — axis names exist, sharded dims
+  divide. This is the same :func:`~kungfu_tpu.parallel.rules
+  .validate_specs` the runtime runs at plan time; running it here
+  means a bad (table, mesh) pair fails lint, before any run.
+
+Like ``vmem-budget``, the whole-tree passes import the REAL registry
+and evaluate the REAL tables over abstract model templates
+(``jax.eval_shape`` — no FLOPs): the single source of truth for the
+rules is the engine, so the lint can never disagree with the plan the
+runtime derives.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .core import Finding, Source, dotted_name
+
+NAME_SPEC = "shard-rules"
+NAME_COVERAGE = "shard-rule-coverage"
+NAME_MESH = "shard-rule-mesh"
+
+#: the one module allowed to construct PartitionSpec literals
+RULES_MODULE_SUFFIX = os.path.join("parallel", "rules.py")
+
+
+def _is_rules_module(path: str) -> bool:
+    """Exactly `.../parallel/rules.py` — separator-anchored so e.g.
+    `dataparallel/rules.py` is NOT exempt."""
+    return path == RULES_MODULE_SUFFIX \
+        or path.endswith(os.sep + RULES_MODULE_SUFFIX)
+#: where the whole-tree passes anchor their findings
+RULES_PATH = os.path.join("kungfu_tpu", "parallel", "rules.py")
+
+
+# -- shard-rules: hand-rolled-spec detection ----------------------------------
+
+
+def _spec_aliases(tree: ast.AST) -> set:
+    """Local names bound to jax.sharding.PartitionSpec by imports."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.endswith("sharding"):
+                for a in node.names:
+                    if a.name == "PartitionSpec":
+                        out.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("jax.sharding", "jax"):
+                    # jax.sharding.PartitionSpec / js.PartitionSpec
+                    base = a.asname or a.name
+                    out.add(f"{base}.PartitionSpec")
+                    out.add(f"{base}.sharding.PartitionSpec")
+    return out
+
+
+class HandRolledSpecPass:
+    name = NAME_SPEC
+    doc = ("literal PartitionSpec(...) construction outside "
+           "parallel/rules.py — specs are declarative table data, "
+           "not per-module code")
+
+    def run(self, src: Source) -> List[Finding]:
+        if _is_rules_module(src.path):
+            return []  # the engine is where specs live
+        aliases = _spec_aliases(src.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = dotted_name(node.func)
+            if cn is None:
+                continue
+            if cn in aliases or cn.endswith(".PartitionSpec"):
+                f = src.finding(
+                    node, NAME_SPEC,
+                    f"hand-rolled PartitionSpec ({cn}(...)) outside "
+                    "parallel/rules.py — use a rules table or a "
+                    "rules.py spec helper (spec/stacked/rows/cols/"
+                    "replicated) so the layout is statically "
+                    "checkable data; a justified exception needs a "
+                    "reasoned suppression")
+                if f:
+                    findings.append(f)
+        return findings
+
+
+# -- the whole-tree passes: evaluate the real registry ------------------------
+
+
+def _covers_rules(paths: Sequence[str]) -> bool:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith("rules.py"):
+            return True
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                if root.endswith("parallel") and "rules.py" in files:
+                    return True
+    return False
+
+
+def _load_registry():
+    from ..parallel import rules
+
+    return rules, rules.REGISTRY
+
+
+def check_coverage(registry: Optional[Dict] = None) -> List[Finding]:
+    """Coverage over the registered templates: unmatched leaves, dead
+    rules, shadowed rules. ``registry`` defaults to the live one (the
+    fixture tests pass a synthetic registry)."""
+    reg = registry
+    if reg is None:
+        _, reg = _load_registry()
+    from ..parallel.rules import _compiled, match_index
+
+    findings: List[Finding] = []
+    for name in sorted(reg):
+        entry = reg[name]
+        table = entry.table
+        template = entry.template()
+        winners: Dict[int, int] = {}   # rule index -> leaves won
+        candidates: Dict[int, int] = {}  # rule index -> leaves matched
+        for path, shape in sorted(template.items()):
+            nd = len(shape)
+            if nd == 0:
+                continue  # scalars never consult the table
+            for i, (pattern, s) in enumerate(table):
+                if _compiled(pattern).fullmatch(path) is None \
+                        or len(s) > nd:
+                    continue
+                candidates[i] = candidates.get(i, 0) + 1
+            win = match_index(table, path, nd)
+            if win is None:
+                findings.append(Finding(
+                    RULES_PATH, 1, NAME_COVERAGE,
+                    f"table {name!r}: leaf {path!r} matches no rule — "
+                    "tables must be total (add a rule or a "
+                    "catch-all)"))
+            else:
+                winners[win] = winners.get(win, 0) + 1
+        for i, (pattern, s) in enumerate(table):
+            if winners.get(i):
+                continue
+            if candidates.get(i):
+                findings.append(Finding(
+                    RULES_PATH, 1, NAME_COVERAGE,
+                    f"table {name!r}: rule {i} ({pattern!r}) is "
+                    "SHADOWED — every leaf it matches is claimed by "
+                    "an earlier rule (ordering bug: first match "
+                    "wins)"))
+            else:
+                findings.append(Finding(
+                    RULES_PATH, 1, NAME_COVERAGE,
+                    f"table {name!r}: rule {i} ({pattern!r}) is DEAD "
+                    "— no registered leaf matches it (path typo, or "
+                    "the model renamed the module and this split "
+                    "silently vanished)"))
+    return findings
+
+
+def check_mesh(registry: Optional[Dict] = None) -> List[Finding]:
+    """Mesh validity: every registered table must instantiate on every
+    mesh shape it declares (axis existence + divisibility) — the same
+    validate_specs the runtime runs at plan time."""
+    reg = registry
+    if reg is None:
+        _, reg = _load_registry()
+    from ..parallel.rules import (PlanError, replicated, spec_for,
+                                  validate_specs)
+
+    import numpy as np
+
+    findings: List[Finding] = []
+    for name in sorted(reg):
+        entry = reg[name]
+        table = entry.table
+        template = entry.template()
+        # rebuild a flat tree of dummy leaves so validate_specs (the
+        # runtime validator — a single implementation, not a copy of
+        # its math) sees the registered shapes
+        tree = {p: np.broadcast_to(np.zeros((), np.uint8), s)
+                for p, s in template.items()}
+        specs = {p: (spec_for(p, len(s), table) or replicated())
+                 for p, s in template.items()}
+        for mesh_shape in entry.mesh_shapes:
+            declared = set(mesh_shape)
+            missing = [ax for ax in table.axes if ax not in declared]
+            for ax in missing:
+                findings.append(Finding(
+                    RULES_PATH, 1, NAME_MESH,
+                    f"table {name!r}: names axis {ax!r} absent from "
+                    f"declared mesh shape {dict(mesh_shape)} — a plan "
+                    "on that mesh raises at runtime"))
+            if missing:
+                continue
+            try:
+                validate_specs(specs, tree, mesh_shape,
+                               table_name=name)
+            except PlanError as e:
+                findings.append(Finding(
+                    RULES_PATH, 1, NAME_MESH, str(e)))
+    return findings
+
+
+class RuleCoveragePass:
+    name = NAME_COVERAGE
+    doc = ("every leaf of every registered model tree matches a rule; "
+           "dead and shadowed rules flag")
+
+    def run_global(self, paths: Sequence[str]) -> List[Finding]:
+        if not _covers_rules(paths):
+            return []
+        return check_coverage()
+
+
+class MeshValidityPass:
+    name = NAME_MESH
+    doc = ("every registered rules table instantiates on every "
+           "declared mesh shape (axes exist, dims divide)")
+
+    def run_global(self, paths: Sequence[str]) -> List[Finding]:
+        if not _covers_rules(paths):
+            return []
+        return check_mesh()

@@ -73,10 +73,10 @@ def bench_cpu(args) -> None:
 def bench_ici(args) -> None:
     import jax
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
 
     from kungfu_tpu.models import fake_model_catalog
     from kungfu_tpu.parallel import data_mesh
+    from kungfu_tpu.parallel.rules import stacked
 
     mesh = data_mesh()
     n = mesh.shape["data"]
@@ -92,8 +92,10 @@ def bench_ici(args) -> None:
             return tuple(jax.lax.psum(b, "data") for b in bs)
 
         return jax.shard_map(
-            dev, mesh=mesh, in_specs=tuple(P("data") for _ in bufs),
-            out_specs=tuple(P("data") for _ in bufs), check_vma=False,
+            dev, mesh=mesh,
+            in_specs=tuple(stacked("data") for _ in bufs),
+            out_specs=tuple(stacked("data") for _ in bufs),
+            check_vma=False,
         )(*bufs)
 
     out = tuple(buffers)

@@ -79,13 +79,14 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     import jax
     import jax.numpy as jnp
     import optax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding
 
     from kungfu_tpu.models import (GPTConfig, GPTLM, gpt_fused_loss,
                                    gpt_loss_with_aux)
     from kungfu_tpu.parallel import (build_gspmd_train_step,
                                      gpt_moe_rules, gpt_tp_rules,
                                      shard_params)
+    from kungfu_tpu.parallel.rules import stacked
 
     n = jax.device_count()
     platform = jax.devices()[0].platform
@@ -119,7 +120,7 @@ def measure_lm_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     params = model.init(jax.random.PRNGKey(0), tokens[:1, :seq])["params"]
     rules = gpt_moe_rules() if experts else gpt_tp_rules()
     params = shard_params(jax.device_get(params), mesh, rules)
-    tokens = jax.device_put(tokens, NamedSharding(mesh, P("data")))
+    tokens = jax.device_put(tokens, NamedSharding(mesh, stacked("data")))
 
     # bf16 expert storage: upcast gradients to f32 BEFORE adam so both
     # moments stay f32 (optax moments follow the update dtype; a bf16
@@ -265,10 +266,11 @@ def measure_pp_rate(size: str = "small", batch: int = 8, seq: int = 1024,
 
     import kungfu_tpu._jax_compat  # noqa: F401  (jax.shard_map on 0.4.x)
     from jax import shard_map
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import Mesh
 
     from kungfu_tpu.models import GPTConfig, GPTLM, stack_gpt_blocks
     from kungfu_tpu.models.gpt import gpt_pipeline_train_step
+    from kungfu_tpu.parallel.rules import replicated, stacked
 
     n = jax.device_count()
     platform = jax.devices()[0].platform
@@ -294,8 +296,9 @@ def measure_pp_rate(size: str = "small", batch: int = 8, seq: int = 1024,
     mapped = shard_map(
         lambda o, s, t: gpt_pipeline_train_step(
             cfg, o, s, t, "pipe", num_microbatches=microbatches),
-        mesh=mesh, in_specs=(P(), P("pipe"), P()),
-        out_specs=(P(), P(), P("pipe")), check_vma=False)
+        mesh=mesh, in_specs=(replicated(), stacked("pipe"), replicated()),
+        out_specs=(replicated(), replicated(), stacked("pipe")),
+        check_vma=False)
     tx = optax.adamw(1e-4)  # stateless transformation: one serves both
     so, ss = tx.init(outer), tx.init(stacked)
 

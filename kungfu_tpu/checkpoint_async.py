@@ -650,14 +650,23 @@ def save_sharded(directory: str, tree, *, step: int, rank: int = 0,
                  nprocs: int = 1, chunk_bytes: Optional[int] = None,
                  incremental: bool = True, gen: Optional[int] = None,
                  meta: Optional[Dict] = None,
-                 residual: Optional[Dict] = None) -> int:
+                 residual: Optional[Dict] = None,
+                 mesh_axes: Optional[Dict] = None) -> int:
     """Synchronously write this rank's shard of one generation.
 
     The blocking convenience form (tests, benchmarks, one-shot tools);
     training loops should use `AsyncShardedCheckpointer`. When saving
     from several ranks, derive `gen` ONCE (e.g. `next_generation`) and
-    pass the same value to every rank. Returns the generation."""
+    pass the same value to every rank. Returns the generation.
+
+    ``mesh_axes`` (e.g. ``dict(mesh.shape)``) records the mesh shape
+    the tree was planned for into ``meta["mesh_axes"]`` — what
+    `restore_on_mesh` diffs the restore-side plan against. Omit it
+    for layouts with no mesh (worker-stacked DP state) and the
+    restore diff conservatively reports every sharded leaf."""
     os.makedirs(directory, exist_ok=True)
+    if mesh_axes is not None:
+        meta = {**(meta or {}), "mesh_axes": dict(mesh_axes)}
     if chunk_bytes is None:
         chunk_bytes = ckpt_chunk_bytes()
     if gen is None:
@@ -1014,6 +1023,47 @@ def restore_sharded(directory: str, like, *, peer=None,
         attempt += 1
 
 
+def restore_on_mesh(directory: str, like, *, mesh, rules_table,
+                    peer=None, gen: Optional[int] = None):
+    """Restore the latest complete generation and PLACE it on ``mesh``
+    per a kfspec rules table — reshard-on-restore generalized from
+    "any np" to "any mesh shape" (ROADMAP item 3: a checkpoint saved
+    on a dp x tp mesh restores onto a tp x pp one).
+
+    The byte plane is :func:`restore_sharded` unchanged (any-np shard
+    exchange, every leaf hash-verified, lockstep fallback). On top of
+    it the placement plane is pure kfspec data: the table derives the
+    spec tree for the RESTORE mesh and validates it at plan time
+    (coverage, axis existence, divisibility — :class:`~kungfu_tpu
+    .parallel.rules.PlanError` before any device_put), then the
+    spec-diff against the SAVE mesh shape (``meta["mesh_axes"]``,
+    recorded by passing ``mesh_axes=dict(mesh.shape)`` to the saver)
+    says exactly which leaves' byte layouts moved; ``place``
+    device_puts per spec (a leaf whose placement signature is
+    unchanged costs a device map update, not a reshuffle). Because
+    both sides derive placement from the same table, the two clusters
+    never exchange specs — the schedule-only discipline
+    chunk/bucket/shard_schedule established.
+
+    Returns ``(placed_tree, step, meta, residual, diff)`` where
+    ``diff`` is ``{leaf path: (save signature, restore signature)}``
+    for the moved leaves. When ``meta`` carries no ``mesh_axes`` (the
+    saver didn't know its mesh, e.g. worker-stacked DP state) the
+    save layout is unknown and the diff is computed against a
+    fully-replicated prior — every sharded leaf reports as moved, the
+    conservative reading."""
+    from .parallel import rules as kfspec
+
+    tree, step, meta, residual = restore_sharded(directory, like,
+                                                 peer=peer, gen=gen)
+    mesh_shape = dict(mesh.shape)
+    specs = kfspec.plan(rules_table, tree, mesh_shape)
+    saved_axes = dict((meta or {}).get("mesh_axes") or {})
+    diff = kfspec.spec_diff(specs, tree, saved_axes, mesh_shape)
+    return (kfspec.place(tree, mesh, specs), step, meta, residual,
+            diff)
+
+
 # -- the async front end ------------------------------------------------------
 
 
@@ -1119,10 +1169,15 @@ class AsyncShardedCheckpointer:
 
     def save(self, tree, step: int, *, meta: Optional[Dict] = None,
              residual: Optional[Dict] = None,
+             mesh_axes: Optional[Dict] = None,
              block: bool = False) -> int:
         """Queue one generation; returns its number immediately (or
         after the write with `block=True`). Raises any error a
         PREVIOUS queued write hit.
+
+        ``mesh_axes`` (e.g. ``dict(mesh.shape)``) records the mesh
+        shape the tree was planned for into ``meta["mesh_axes"]`` —
+        the save-side half of `restore_on_mesh`'s spec diff.
 
         The generation number IS `step` (which must be the
         cluster-agreed training step, >= 1): no local counter exists
@@ -1138,6 +1193,8 @@ class AsyncShardedCheckpointer:
             raise ValueError(
                 f"save() needs the cluster-agreed step >= 1, got "
                 f"{step} — generation numbers derive from it")
+        if mesh_axes is not None:
+            meta = {**(meta or {}), "mesh_axes": dict(mesh_axes)}
         keys, shapes, dtypes, _ = tree_spec(tree)
         owned = self._owned_indices(keys, shapes, dtypes)
         leaves = jax.tree_util.tree_leaves(tree)

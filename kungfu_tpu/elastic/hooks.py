@@ -200,7 +200,8 @@ class ElasticCallback:
         return self.state.step, self.state.trained_samples
 
     def resync_params(self, params, root: int = 0,
-                      chunk_mb: Optional[float] = None):
+                      chunk_mb: Optional[float] = None,
+                      placement=None):
         """Broadcast a params pytree from `root` over DCN so joiners adopt
         survivor state (the reference's BroadcastGlobalVariablesOp at the
         epoch boundary). Byte-exact: dtypes (incl. ints/bools) survive.
@@ -227,8 +228,38 @@ class ElasticCallback:
         stream byte-exactly). Live-rank resyncs should NOT broadcast
         EF residuals between ranks: they are per-rank state
         (docs/grad_pipeline.md, "Residuals and the elastic
-        runtime")."""
+        runtime").
+
+        `placement`: optional ``(mesh, rules_table[, prev_axes])`` —
+        after the broadcast, re-place the tree on `mesh` per the
+        kfspec table (`parallel/rules.py`). Joiner resharding is then
+        SPEC-DIFF driven: the plan is validated at plan time, the
+        diff against `prev_axes` (the mesh shape the tree was last
+        planned for; None means unknown/fresh) records which leaves'
+        byte layouts actually moved and what the placement cost
+        (`reshard_leaves` / `reshard_ms` in `last_resize_timings`),
+        and placement derives from the same table on every rank — no
+        specs cross the wire."""
         from .streaming import stream_broadcast, stream_chunk_bytes
+
+        def _place(tree):
+            """(placed tree, {reshard_leaves, reshard_ms}) — the
+            placement phase is timed so a joiner's dominant reshard
+            cost shows up in last_resize_timings / the resize.resync
+            span, not as an unattributed gap in the span wall."""
+            if placement is None:
+                return tree, {}
+            from ..parallel import rules as kfspec
+
+            t_p0 = time.perf_counter()
+            mesh, table, *rest = placement
+            placed, diff = kfspec.reshard(
+                tree, mesh, table,
+                prev_axes=rest[0] if rest else None)
+            return placed, {
+                "reshard_leaves": len(diff),
+                "reshard_ms": (time.perf_counter() - t_p0) * 1e3,
+            }
 
         t0 = time.perf_counter()
         chunk_bytes = stream_chunk_bytes(chunk_mb)
@@ -246,6 +277,7 @@ class ElasticCallback:
                 t_bcast = time.perf_counter()
                 self.sync_position()
                 t_pos = time.perf_counter()
+                out, place_phases = _place(out)
                 self.last_resize_timings = {
                     **self.peer.last_resize_phases,
                     "pack_ms": phases["pack_ms"],
@@ -254,6 +286,7 @@ class ElasticCallback:
                     "stream_wall_ms": phases["wall_ms"],
                     "stream_chunks": phases["chunks"],
                     "position_ms": (t_pos - t_bcast) * 1e3,
+                    **place_phases,
                 }
                 sp.set(**{k: round(v, 3) if isinstance(v, float) else v
                           for k, v in self.last_resize_timings.items()})
@@ -265,15 +298,17 @@ class ElasticCallback:
             t_bcast = time.perf_counter()
             self.sync_position()
             t_pos = time.perf_counter()
+            out, place_phases = _place(unpack_bytes(synced, params))
             self.last_resize_timings = {
                 **self.peer.last_resize_phases,
                 "pack_ms": (t_pack - t0) * 1e3,
                 "broadcast_ms": (t_bcast - t_pack) * 1e3,
                 "position_ms": (t_pos - t_bcast) * 1e3,
+                **place_phases,
             }
             sp.set(**{k: round(v, 3) if isinstance(v, float) else v
                       for k, v in self.last_resize_timings.items()})
-            return unpack_bytes(synced, params)
+            return out
 
 
 def shard_offset(

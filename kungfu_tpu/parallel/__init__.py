@@ -27,8 +27,10 @@ from .expert import (MoEParams, dispatch_tensors, init_moe_params,
                      moe_capacity, moe_mlp)
 from .pipeline import (pipeline_apply, pipeline_train_step_1f1b,
                        stack_stage_params)
-from .tensor import (bert_tp_rules, gpt_moe_rules, gpt_tp_rules,
-                     shard_params)
+from .rules import (PlanError, RuleTable, bert_tp_rules, gpt_moe_rules,
+                    gpt_pp_rules, gpt_tp_rules, match_partition_rules,
+                    moe_ep_rules, reshard, seq_sp_rules, shard_params,
+                    spec_diff, tree_specs)
 from .vocab_ce import vocab_sharded_fused_ce
 from .train import (build_dp_replicated_train_step, build_eval_step,
                     build_gspmd_train_step, build_train_step,
@@ -61,6 +63,15 @@ __all__ = [
     "bert_tp_rules",
     "gpt_tp_rules",
     "gpt_moe_rules",
+    "gpt_pp_rules",
+    "moe_ep_rules",
+    "seq_sp_rules",
+    "match_partition_rules",
+    "tree_specs",
+    "spec_diff",
+    "reshard",
+    "PlanError",
+    "RuleTable",
     "shard_params",
     "vocab_sharded_fused_ce",
     "zero1_shard_opt_state",

@@ -11,6 +11,11 @@ All functions run INSIDE `shard_map` over the expert axis, like the
 other mixers in this package. Capacity overflow tokens are dropped (the
 standard trade: static shapes for the MXU; raise `capacity_factor` to
 keep more).
+
+Placement is kfspec data: `rules.moe_ep_rules()` is the global-view
+table (expert stacks split their leading dim, the router replicates
+— it must be identical for routing to agree), statically verified by
+the shard-rule passes (docs/sharding_rules.md).
 """
 
 from __future__ import annotations

@@ -23,7 +23,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
+
+from .rules import stacked
 
 
 def data_mesh(
@@ -58,7 +60,7 @@ def axis_size(mesh: Mesh, axis_name: str = "data") -> int:
 
 def worker_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
     """Sharding of worker-stacked state: leading dim split over the axis."""
-    return NamedSharding(mesh, P(axis_name))
+    return NamedSharding(mesh, stacked(axis_name))
 
 
 def replicate_to_workers(tree, mesh: Mesh, axis_name: str = "data"):
@@ -96,8 +98,8 @@ def init_worker_state(tx, stacked_params, mesh: Mesh,
     f = shard_map(
         dev_init,
         mesh=mesh,
-        in_specs=(P(axis_name),),
-        out_specs=P(axis_name),
+        in_specs=(stacked(axis_name),),
+        out_specs=stacked(axis_name),
         check_vma=False,
     )
     return jax.jit(f)(stacked_params)
@@ -111,8 +113,8 @@ def _broadcast_fn(mesh: Mesh, root: int, axis_name: str):
         shard_map(
             lambda t: bc_op(t, axis_name, root),
             mesh=mesh,
-            in_specs=(P(axis_name),),
-            out_specs=P(axis_name),
+            in_specs=(stacked(axis_name),),
+            out_specs=stacked(axis_name),
             check_vma=False,
         )
     )

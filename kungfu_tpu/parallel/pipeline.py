@@ -11,6 +11,12 @@ running all P stages in parallel on different microbatches.
 Runs INSIDE `shard_map` over the pipe axis like the other mixers. The
 loop is a `lax.fori_loop` with static shapes, so XLA compiles one
 program per device.
+
+Placement is kfspec data: `rules.gpt_pp_rules()` is the stage-stacked
+table for `stack_stage_params`/`stack_gpt_blocks` trees (leading
+stage dim over the pipe axis; the tp-composed variant covers
+dp x tp x pp), statically verified against the dryrun shapes by the
+shard-rule passes (docs/sharding_rules.md).
 """
 
 from __future__ import annotations

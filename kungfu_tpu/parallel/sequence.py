@@ -21,6 +21,12 @@ devices, and attention — the one op that mixes positions — runs either
 All functions are written for use INSIDE `shard_map` over a mesh axis
 (the same way `ops/collective.py` primitives are), with static shapes
 and `lax.fori_loop` control flow so XLA compiles one program per device.
+
+Placement is kfspec data: `rules.seq_sp_rules()` is the
+sequence-parallel table (params replicate — the mixers shard the
+SEQUENCE, not the weights; `token_spec` carries the [B, T] rows-over-
+data, positions-over-seq layout), statically verified by the
+shard-rule passes (docs/sharding_rules.md).
 """
 
 from __future__ import annotations

@@ -7,112 +7,28 @@ mesh axis and let XLA's SPMD partitioner insert the all-gathers /
 reduce-scatters on ICI (the "How to Scale Your Model" recipe: pick a
 mesh, annotate, let the compiler schedule).
 
-This module provides the Megatron-style annotation rules for the
-transformer layers in `models/`:
-
-- column-parallel: split a Dense kernel's OUTPUT features (QKV
-  projections, MLP up-projection) — activations come out sharded;
-- row-parallel: split the INPUT features (attention output projection,
-  MLP down-projection) — XLA inserts one psum to rejoin.
-
-`shard_params` walks a params pytree, matches leaf paths against rules,
-and `jax.device_put`s each leaf with its spec (unmatched leaves are
-replicated). Everything composes with the worker-stacked DP layout by
-using a 2-D mesh, e.g. ("data", "model").
+The Megatron-style rules for the transformer layers in `models/` —
+column-parallel QKV/up-projections, row-parallel output/down-
+projections — live as DATA in `parallel/rules.py` (kfspec), one
+ordered table per model family, statically verified by the
+shard-rule-coverage / shard-rule-mesh passes. This module is the
+historical import surface: every name here delegates to the engine,
+so pre-engine call sites (`shard_params(params, mesh,
+gpt_tp_rules())`) keep working unchanged while the specs themselves
+are checkable data.
 """
 
 from __future__ import annotations
 
-import re
-from typing import Dict, Optional, Sequence, Tuple
+from .rules import (Rules, bert_tp_rules, gpt_moe_rules,  # noqa: F401
+                    gpt_tp_rules, shard_params, spec_for, tree_specs)
 
-import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-# rule: (path regex, PartitionSpec). First match wins.
-Rules = Sequence[Tuple[str, P]]
-
-
-def _megatron_rules(scope: str, axis: str) -> Rules:
-    """The Megatron split, anchored to a transformer-block scope name.
-
-    Anchoring matters: the models' top-level vocab logits heads are also
-    auto-named `Dense_0`, and vocab sizes (30522/50257) rarely divide a
-    model axis — heads and embeddings stay replicated by not matching.
-    """
-    return (
-        # attention (flax MultiHeadDotProductAttention / the seq-parallel
-        # modules): QKV projections column-parallel (heads shard), output
-        # projection row-parallel
-        (r".*(query|key|value).*kernel", P(None, axis, None)),
-        (rf".*{scope}.*out.*kernel", P(axis, None, None)),
-        # MLP: up-projection column-parallel, down-projection row-parallel
-        (rf".*{scope}.*Dense_0.*kernel", P(None, axis)),
-        (rf".*{scope}.*Dense_1.*kernel", P(axis, None)),
-        # biases of column-parallel layers shard with the features
-        (r".*(query|key|value).*bias", P(axis, None)),
-        (rf".*{scope}.*Dense_0.*bias", P(axis,)),
-    )
-
-
-def bert_tp_rules(axis: str = "model") -> Rules:
-    """Megatron split for models/bert.py parameter paths."""
-    return _megatron_rules("TransformerLayer", axis)
-
-
-def gpt_tp_rules(axis: str = "model") -> Rules:
-    """Megatron split for models/gpt.py parameter paths (Block scope)."""
-    return _megatron_rules("Block", axis)
-
-
-def gpt_moe_rules(axis: str = "model") -> Rules:
-    """Expert sharding for `models.gpt.MoEMLP`'s global stacks, composed
-    with the Megatron split: expert stacks [E, H, F] shard their expert
-    dim over `axis`, the router stays replicated, and the non-MoE rules
-    apply to attention. GSPMD lowers the dispatch/combine einsums to
-    all-to-alls across the expert shards."""
-    return (
-        (r".*moe.*w_(up|down)", P(axis, None, None)),
-        (r".*moe.*router", P()),
-    ) + gpt_tp_rules(axis)
-
-
-def _path_str(path) -> str:
-    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                    for p in path)
-
-
-def spec_for(path: str, ndim: int, rules: Rules) -> Optional[P]:
-    for pattern, spec in rules:
-        if re.fullmatch(pattern, path):
-            if len(spec) > ndim:  # rule written for a larger rank
-                continue
-            return spec
-    return None
-
-
-def tree_specs(params, rules: Rules) -> Dict[str, P]:
-    """{leaf path: PartitionSpec} for every matched leaf (debugging aid)."""
-    out = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
-        s = spec_for(_path_str(path), np.ndim(leaf), rules)
-        if s is not None:
-            out[_path_str(path)] = s
-    return out
-
-
-def shard_params(params, mesh: Mesh, rules: Rules):
-    """Place every parameter on `mesh` per the first matching rule;
-    unmatched leaves are replicated. Returns the resharded pytree."""
-
-    def place(path, leaf):
-        spec = spec_for(_path_str(path), np.ndim(leaf), rules)
-        sharding = NamedSharding(mesh, spec if spec is not None else P())
-        return jax.device_put(leaf, sharding)
-
-    return jax.tree_util.tree_map_with_path(place, params)
-
-
-# batch placement for dp x tp (leading axis over "data", replicated over
-# "model") is exactly mesh.shard_batch(batch, mesh, axis_name="data")
+__all__ = [
+    "Rules",
+    "bert_tp_rules",
+    "gpt_tp_rules",
+    "gpt_moe_rules",
+    "spec_for",
+    "tree_specs",
+    "shard_params",
+]

@@ -16,7 +16,9 @@ import jax
 import optax
 from jax import lax
 from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
+
+from .rules import replicated, stacked
 
 
 def _squeeze(t):
@@ -69,8 +71,10 @@ def build_train_step_with_state(
     mapped = shard_map(
         device_step,
         mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name), P(axis_name)),
-        out_specs=(P(axis_name), P(axis_name), P(axis_name), P()),
+        in_specs=(stacked(axis_name), stacked(axis_name),
+                  stacked(axis_name), stacked(axis_name)),
+        out_specs=(stacked(axis_name), stacked(axis_name),
+                   stacked(axis_name), replicated()),
         check_vma=False,
     )
     donate_argnums: Tuple[int, ...] = (0, 1, 2) if donate else ()
@@ -184,8 +188,8 @@ def build_dp_replicated_train_step(
     mapped = shard_map(
         device_step,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name)),
-        out_specs=(P(), P(), P()),
+        in_specs=(replicated(), replicated(), stacked(axis_name)),
+        out_specs=(replicated(), replicated(), replicated()),
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
@@ -205,8 +209,8 @@ def build_eval_step(
     mapped = shard_map(
         device_eval,
         mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name)),
-        out_specs=P(),
+        in_specs=(stacked(axis_name), stacked(axis_name)),
+        out_specs=replicated(),
         check_vma=False,
     )
     return jax.jit(mapped)

@@ -47,9 +47,10 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from .. import _jax_compat  # noqa: F401  (jax.shard_map on 0.4.x)
+from .rules import cols, replicated, rows, spec, stacked
 from ..ops.fused_ce import (_PAD_BIAS, _dw_pallas, _dx_pallas,
                             _fwd_pallas, _fwd_vmem_bytes, _pick_blocks,
                             _recompute_vmem_bytes, _residual_d_pallas,
@@ -127,12 +128,12 @@ def _vs_fwd(cfg: _VSConfig, x, w, b, t):
             return loss, lse_g, num_valid, logits
         return loss, lse_g, num_valid
 
-    out_specs = (P(), P(da, None), P())
+    out_specs = (replicated(), rows(da), replicated())
     if cfg.residual:
-        out_specs = out_specs + (P(da, ma),)
+        out_specs = out_specs + (spec(da, ma),)
     out = jax.shard_map(
         shard_fwd, mesh=cfg.mesh,
-        in_specs=(P(da, None), P(None, ma), P(ma), P(da)),
+        in_specs=(rows(da), cols(ma), stacked(ma), stacked(da)),
         out_specs=out_specs, check_vma=False)(x, w, b, t)
     loss, lse_g, num_valid = out[:3]
     logits = out[3] if cfg.residual else None
@@ -175,14 +176,14 @@ def _vs_bwd(cfg: _VSConfig, res, g):
         return dx.astype(x.dtype), dw.astype(w.dtype), db
 
     args = (g, num_valid, x, w, b, t, lse_g)
-    in_specs = (P(), P(), P(da, None), P(None, ma), P(ma), P(da),
-                P(da, None))
+    in_specs = (replicated(), replicated(), rows(da), cols(ma),
+                stacked(ma), stacked(da), rows(da))
     if cfg.residual:
         args = args + (logits,)
-        in_specs = in_specs + (P(da, ma),)
+        in_specs = in_specs + (spec(da, ma),)
     dx, dw, db = jax.shard_map(
         shard_bwd, mesh=cfg.mesh, in_specs=in_specs,
-        out_specs=(P(da, None), P(None, ma), P(ma)),
+        out_specs=(rows(da), cols(ma), stacked(ma)),
         check_vma=False)(*args)
     return dx, dw, db, np.zeros(t.shape, jax.dtypes.float0)
 

@@ -33,7 +33,9 @@ correctness never depends on shardability.
 from __future__ import annotations
 
 import jax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
+
+from .rules import spec
 
 
 def _zero1_spec(leaf, existing, axis_name: str, axis_size: int):
@@ -48,7 +50,7 @@ def _zero1_spec(leaf, existing, axis_name: str, axis_size: int):
     if any(axis_name == p or (isinstance(p, tuple) and axis_name in p)
            for p in prev):
         return None  # data axis already used elsewhere in this leaf
-    return P(axis_name, *prev[1:])
+    return spec(axis_name, *prev[1:])
 
 
 def zero1_shard_opt_state(opt_state, mesh, axis_name: str = "data"):

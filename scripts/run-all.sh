@@ -104,6 +104,37 @@ with tempfile.TemporaryDirectory() as d:
 print("CHECKPOINT SMOKE OK")
 EOF
 
+# mesh-shape-change restore (kfspec, docs/sharding_rules.md): a
+# checkpoint saved under a dp x tp layout restores onto a tp x pp
+# mesh via the rules-table spec diff — placement validated at plan
+# time, leaf hashes verified by restore_sharded
+timeout 120 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=4" python - <<'EOF'
+import tempfile
+import jax, jax.numpy as jnp, numpy as np
+from kungfu_tpu import checkpoint_async as ca
+from kungfu_tpu.models import BertConfig, BertEncoder
+from kungfu_tpu.parallel import rules
+cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                 num_heads=4, intermediate_size=64, max_position=8,
+                 dtype=jnp.float32)
+params = jax.device_get(BertEncoder(cfg).init(
+    jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"])
+with tempfile.TemporaryDirectory() as d:
+    ca.save_sharded(d, params, step=3, rank=0, nprocs=1,
+                    mesh_axes={"data": 2, "model": 2})
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:4]).reshape(2, 2),
+        ("model", "pipe"))
+    placed, step, meta, _, diff = ca.restore_on_mesh(
+        d, params, mesh=mesh, rules_table=rules.bert_tp_rules())
+    assert step == 3 and diff == {}, (step, diff)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(jax.device_get(placed))):
+        np.testing.assert_array_equal(a, b)
+print("DP*TP -> TP*PP RESTORE SMOKE OK")
+EOF
+
 echo "== [4d/7] kftrace smoke: 2-peer traced resize -> Chrome trace validates =="
 # the observability plane (docs/observability.md): a traced elastic
 # run must flight-dump per-rank JSONL, the exporter must merge it into

@@ -103,11 +103,14 @@ class TestTensorParallel:
     def test_rules_hit_intended_kernels(self):
         _, params, _ = make()
         specs = tree_specs(params, gpt_tp_rules())
-        kernels = [k for k in specs if k.endswith("kernel")]
-        # per layer: query, key, value, out, Dense_0, Dense_1
-        assert len(kernels) == CFG.num_layers * 6, sorted(specs)
+        # tables are total (kfspec): every leaf has a spec; the SHARDED
+        # ones must be exactly the per-layer query/key/value/out/
+        # Dense_0/Dense_1 kernels (+ their column-parallel biases)
+        sharded = {k for k, s in specs.items() if s != P()}
+        kernels = [k for k in sharded if k.endswith("kernel")]
+        assert len(kernels) == CFG.num_layers * 6, sorted(sharded)
         assert not any("lm_head" in k or "wte" in k or "wpe" in k
-                       for k in specs), sorted(specs)
+                       for k in sharded), sorted(sharded)
 
     def test_tp_forward_matches_unsharded(self):
         model, params, tokens = make()

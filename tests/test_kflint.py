@@ -1443,6 +1443,173 @@ def test_stale_audit_skips_single_file_spot_checks():
             if f.pass_name == "stale-suppression"] == []
 
 
+# -- shard-rules (kfspec): hand-rolled specs, rules-backed axes --------------
+
+
+def test_shard_rules_fires_on_literal_partition_spec():
+    from kungfu_tpu.analysis.shard_rules import HandRolledSpecPass
+
+    findings = fire(HandRolledSpecPass(), """
+        from jax.sharding import PartitionSpec
+        import jax.sharding
+
+        def f():
+            a = PartitionSpec("data")
+            b = jax.sharding.PartitionSpec(None, "model")
+            return a, b
+    """)
+    assert len(findings) == 2
+    assert all("hand-rolled PartitionSpec" in f.message
+               for f in findings)
+
+
+def test_shard_rules_fires_on_aliased_import():
+    from kungfu_tpu.analysis.shard_rules import HandRolledSpecPass
+
+    findings = fire(HandRolledSpecPass(), """
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("data", None)
+    """)
+    assert len(findings) == 1
+
+
+def test_shard_rules_quiet_on_engine_helpers_and_rules_module():
+    from kungfu_tpu.analysis.shard_rules import HandRolledSpecPass
+
+    # the helpers ARE the migration target: no finding
+    assert fire(HandRolledSpecPass(), """
+        from kungfu_tpu.parallel.rules import rows, stacked
+
+        def f():
+            return stacked("data"), rows("model")
+    """) == []
+    # the engine module itself is where literals live
+    assert run_source(
+        HandRolledSpecPass(),
+        "from jax.sharding import PartitionSpec\n"
+        "X = PartitionSpec('a')\n",
+        path="kungfu_tpu/parallel/rules.py") == []
+
+
+def test_shard_rules_suppression_needs_reason_comment():
+    from kungfu_tpu.analysis.shard_rules import HandRolledSpecPass
+
+    assert fire(HandRolledSpecPass(), """
+        from jax.sharding import PartitionSpec as P
+
+        def f():
+            # kflint: disable=shard-rules — throwaway debug literal
+            return P("data")
+    """) == []
+
+
+def test_axis_consistency_resolves_axes_from_rules_table():
+    # specs-as-data: the table call declares its axis universe via the
+    # live registry (rules.TABLE_AXES), so a collective naming an axis
+    # outside it fires even with zero spec literals in the module...
+    findings = fire(AxisConsistencyPass(), """
+        from jax import lax, shard_map
+        from kungfu_tpu.parallel.rules import gpt_tp_rules
+
+        RULES = gpt_tp_rules()
+
+        def build(mesh, specs):
+            def body(x):
+                return lax.psum(x, "modle")
+            return shard_map(body, mesh=mesh, in_specs=specs,
+                             out_specs=specs)
+    """)
+    assert len(findings) == 1
+    assert "modle" in findings[0].message
+
+
+def test_axis_consistency_quiet_on_table_declared_axis():
+    # ...and stays quiet when the axis IS in the table's universe
+    findings = fire(AxisConsistencyPass(), """
+        from jax import lax, shard_map
+        from kungfu_tpu.parallel.rules import gpt_tp_rules
+
+        RULES = gpt_tp_rules()
+
+        def build(mesh, specs):
+            def body(x):
+                return lax.psum(x, "model")
+            return shard_map(body, mesh=mesh, in_specs=specs,
+                             out_specs=specs)
+    """)
+    assert findings == []
+
+
+def test_axis_consistency_literal_fallback_via_helper_args():
+    # the literal path survives the rewire: a spec-helper call's
+    # string argument declares the axis at the call site
+    fire_src = """
+        from jax import lax, shard_map
+        from kungfu_tpu.parallel.rules import stacked
+
+        def build(mesh):
+            def body(x):
+                return lax.psum(x, "AXIS")
+            return shard_map(body, mesh=mesh,
+                             in_specs=(stacked("data"),),
+                             out_specs=stacked("data"))
+    """
+    assert len(fire(AxisConsistencyPass(), fire_src)) == 1
+    assert fire(AxisConsistencyPass(),
+                fire_src.replace('"AXIS"', '"data"')) == []
+
+
+def test_schedule_purity_fires_on_impure_rules_table():
+    findings = fire_project(SchedulePurityPass(), mod="""
+        import os
+
+        def my_rules():
+            if os.environ.get("KF_TP_AXIS"):
+                return (("a", 1),)
+            return (("b", 2),)
+    """)
+    assert findings
+    assert "rules table my_rules()" in findings[0].message
+
+
+def test_schedule_purity_quiet_on_pure_rules_table():
+    assert fire_project(SchedulePurityPass(), mod="""
+        def my_rules(axis="model"):
+            return ((".*kernel", axis), (".*", None))
+    """) == []
+
+
+def test_stale_shard_rules_suppression_audits(tmp_path):
+    # the audit covers the new marker: a `# kflint: disable=shard-rules`
+    # that no longer suppresses a live finding is itself a finding
+    f = tmp_path / "stale.py"
+    f.write_text("# kflint: disable=shard-rules — nothing here\n"
+                 "X = 1\n")
+    findings = run_paths([str(tmp_path)])
+    assert any(x.pass_name == "stale-suppression"
+               and "shard-rules" in x.message for x in findings)
+
+
+def test_schedule_purity_covers_match_partition_rules_feeders():
+    findings = fire_project(SchedulePurityPass(), mod="""
+        import os
+
+        def match_partition_rules(rules, tree):
+            return rules
+
+        def pick_table():
+            return os.environ.get("KF_TABLE")
+
+        def derive_plan(tree):
+            t = pick_table()
+            return match_partition_rules(t, tree)
+    """)
+    assert findings
+    assert any("match_partition_rules() argument fed by "
+               "pick_table()" in f.message for f in findings)
+
+
 # -- suppression / plumbing --------------------------------------------------
 
 
@@ -1459,13 +1626,36 @@ def test_skip_file_marker():
 
 
 def test_pass_registry_names_are_unique_and_complete():
-    names = [p.name for p in all_passes()]
+    # core.PASS_SPECS is THE registry: the CLI, run_paths and this
+    # suite all derive from it, so a pass cannot exist without its
+    # CLI/baseline wiring (the old two-list split allowed exactly
+    # that silent skip)
+    from kungfu_tpu.analysis.core import PASS_SPECS
+
+    passes = all_passes()
+    names = [p.name for p in passes]
     assert len(names) == len(set(names))
+    assert len(passes) == len(PASS_SPECS)
     assert set(names) >= {"retry-discipline", "axis-consistency",
                           "trace-purity", "vmem-budget",
                           "lock-discipline", "unused-imports",
+                          "shard-rules", "shard-rule-coverage",
+                          "shard-rule-mesh",
                           "wire-name-determinism", "collective-order",
                           "schedule-purity", "lock-order"}
+
+
+def test_cli_list_shows_every_registered_pass():
+    # --list renders from the same registry; a row missing here means
+    # a pass the CLI cannot select or baseline
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.analysis", "--list"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0
+    listed = {line.split()[0] for line in r.stdout.splitlines()
+              if line.strip()}
+    assert listed == {p.name for p in all_passes()}
 
 
 # -- the point: the tree itself lints clean ----------------------------------

@@ -49,12 +49,14 @@ def test_rules_match_intended_kernels():
 def test_vocab_head_stays_replicated():
     """The encoder's top-level logits head is also auto-named Dense_0;
     vocab sizes rarely divide a model axis, so it must not match the
-    MLP rules (it crashed device_put with the default 30522 vocab)."""
+    MLP rules (it crashed device_put with the default 30522 vocab).
+    Tables are now TOTAL (kfspec): the head falls through to the
+    catch-all and replicates, instead of silently not matching."""
     from kungfu_tpu.parallel.tensor import spec_for
 
     rules = bert_tp_rules()
-    assert spec_for("Dense_0/kernel", 2, rules) is None
-    assert spec_for("Dense_0/bias", 1, rules) is None
+    assert spec_for("Dense_0/kernel", 2, rules) == P()
+    assert spec_for("Dense_0/bias", 1, rules) == P()
     assert spec_for("TransformerLayer_0/Dense_0/kernel", 2, rules) \
         == P(None, "model")
     assert spec_for("TransformerLayer_1/Dense_1/kernel", 2, rules) \
