@@ -50,9 +50,14 @@ NAME = "schedule-purity"
 #: spec tree from shapes/paths alone (parallel/rules.py, kfspec), the
 #: same discipline chunk/bucket/shard layouts already obey. Rules-
 #: table constructors (the `*_rules` convention) are checked as
-#: schedule bodies too, below.
+#: schedule bodies too, below. `compile_scenario` (scenario/
+#: compiler.py) is the fifth member: a scenario plan is replayed by
+#: EVERY rank from its own env copy — a clock/env/value read in the
+#: lowering means two ranks replay different traces, the same
+#: divergence class as a per-rank chunk layout.
 SCHEDULE_FUNCS = {"chunk_schedule", "bucket_schedule",
-                  "shard_schedule", "match_partition_rules"}
+                  "shard_schedule", "match_partition_rules",
+                  "compile_scenario"}
 
 
 def _is_rules_table_fn(name: str) -> bool:

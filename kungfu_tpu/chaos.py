@@ -28,8 +28,21 @@ Schedule format (``KF_CHAOS`` inline JSON, or ``KF_CHAOS_FILE`` path)::
         {"type": "die_config_server", "after_requests": 10},
         {"type": "drop_control", "name": "update", "count": 1},
         {"type": "delay_control", "name": "update", "ms": 100, "count": 2},
-        {"type": "spawn_delay", "rank": 2, "ms": 500, "count": 1}
+        {"type": "spawn_delay", "rank": 2, "ms": 500, "count": 1},
+        {"type": "straggler_worker", "rank": 1, "from_step": 4,
+         "to_step": 8, "ms": 120, "count": 5},
+        {"type": "preempt_warning", "step": 6, "lead_steps": 2}
     ]}
+
+``straggler_worker`` models a slow host: the matching rank sleeps
+``ms`` at every step boundary inside [from_step, to_step] (``count``
+bounds the total firings per process — the scenario compiler sets it
+to the window length). Each firing emits a ``chaos.straggler`` SPAN
+(not an instant) so the goodput plane can attribute the other ranks'
+collective wait to the straggler's sleep windows by overlap.
+``preempt_warning`` is the spot-VM lead-time notice: an informational
+marker + trace event `lead_steps` before a scheduled preemption —
+policies and traces can see it coming; nothing destructive fires.
 
 Every fault that fires prints one ``KF_CHAOS_FIRE`` marker line with a
 wall-clock timestamp — the anchor the MTTR benchmark uses to measure
@@ -64,6 +77,8 @@ _KNOWN_TYPES = {
     "drop_control",
     "delay_control",
     "spawn_delay",
+    "straggler_worker",
+    "preempt_warning",
 }
 
 
@@ -210,10 +225,19 @@ def _fire(ftype: str, **info) -> None:
 # -- hook points --------------------------------------------------------------
 
 def on_step(rank: int, step: int) -> None:
-    """ElasticCallback.after_step: scheduled worker crashes fire here."""
+    """ElasticCallback.after_step (entry): scheduled worker crashes and
+    preemption warnings fire here."""
     sched = active()
     if sched is None:
         return
+    f = sched.take("preempt_warning", rank=rank, step=step)
+    if f is not None:
+        # informational: the spot fabric's lead-time notice. Scheduled
+        # at (preempt step - lead_steps) by the scenario compiler; the
+        # trace records it so goodput timelines and policies can see
+        # the preemption coming (docs/fault_tolerance.md).
+        _fire("preempt_warning", rank=rank, step=step,
+              lead_steps=int(f.spec.get("lead_steps", 0)))
     f = sched.take("crash_worker", rank=rank, step=step)
     if f is None:
         return
@@ -228,6 +252,40 @@ def on_step(rank: int, step: int) -> None:
     if sig == "EXIT":
         os._exit(int(f.spec.get("code", 41)))
     os.kill(os.getpid(), getattr(signal, f"SIG{sig}", signal.SIGKILL))
+
+
+def on_step_end(rank: int, step: int) -> None:
+    """ElasticCallback.after_step (exit): straggler sleeps fire here,
+    AFTER the consensus round — a slow host is late to the *next*
+    step's gradient all-reduce (benchmarks/straggler.py's shape), so
+    its peers' wait shows up in their ``step.grad_wire`` spans, which
+    is where the goodput plane and the straggler policies look.
+    Sleeping at the entry hook instead would stall peers inside the
+    resize consensus, misattributing the wait to the control plane."""
+    sched = active()
+    if sched is None:
+        return
+    f = sched.take(
+        "straggler_worker", rank=rank,
+        _when=lambda f: (int(f.spec.get("from_step", 0)) <= step
+                         <= int(f.spec.get("to_step", 1 << 30))))
+    if f is not None:
+        ms = float(f.spec.get("ms", 100))
+        # a SPAN, not the usual _fire instant: the sleep window is what
+        # the goodput decomposition overlaps other ranks' collective
+        # waits against (trace/goodput.py). The KF_CHAOS_FIRE marker
+        # still prints so harness assertions see the fault.
+        print(f"KF_CHAOS_FIRE t={time.time() * 1e3:.1f} "
+              f"type=straggler_worker rank={rank} step={step} ms={ms}",
+              flush=True)
+        from . import trace
+
+        rec = trace.recorder() if trace.enabled() else None
+        t0_us = rec.now_us() if rec is not None else 0
+        time.sleep(ms / 1e3)
+        if rec is not None:
+            trace.complete("chaos.straggler", t0_us,
+                           rec.now_us() - t0_us, cat="chaos", ms=ms)
 
 
 def on_http_request(path: str) -> Optional[Dict]:
@@ -245,13 +303,22 @@ def on_http_request(path: str) -> Optional[Dict]:
     if f is not None:
         _fire("die_config_server", request=idx)
         return {"die": True}
-    f = sched.take("delay_http", path=path)
+    # `after_requests` (optional, default 0 = immediately) arms a
+    # delay/refuse fault only from that request index on — the knob
+    # the scenario compiler lowers a step coordinate to (~1 GET per
+    # step per rank), so a mid-run control-plane flap starts mid-run
+    # instead of at boot
+    f = sched.take(
+        "delay_http", path=path,
+        _when=lambda f: idx >= int(f.spec.get("after_requests", 0)))
     if f is not None:
         ms = float(f.spec.get("ms", 100))
         _fire("delay_http", path=path, ms=ms, request=idx)
         time.sleep(ms / 1e3)
         return {"delay_ms": ms}
-    f = sched.take("refuse_http", path=path)
+    f = sched.take(
+        "refuse_http", path=path,
+        _when=lambda f: idx >= int(f.spec.get("after_requests", 0)))
     if f is not None:
         status = int(f.spec.get("status", 503))
         _fire("refuse_http", path=path, status=status, request=idx)

@@ -8,7 +8,8 @@ propose/resize/state-resync from inside a training loop.
 
 from .config_server import ConfigServer
 from .hooks import ElasticCallback, ElasticState
-from .policy import NoiseScalePolicy
+from .policy import (GoodputPolicy, NaiveStragglerPolicy,
+                     NoiseScalePolicy)
 from .schedule import step_based_schedule
 from .streaming import stream_broadcast, stream_chunk_bytes
 
@@ -18,6 +19,8 @@ __all__ = [
     "ElasticCallback",
     "ElasticState",
     "NoiseScalePolicy",
+    "GoodputPolicy",
+    "NaiveStragglerPolicy",
     "stream_broadcast",
     "stream_chunk_bytes",
 ]

@@ -66,6 +66,12 @@ from kungfu_tpu.ops.collective import defuse, fuse
 
 TOTAL_STEPS = int(os.environ.get("TEST_TOTAL_STEPS", "12"))
 SCHEDULE = os.environ.get("TEST_SCHEDULE", "6:2,6:4")
+# KF_POLICY switches the sizing driver from the static schedule to a
+# monitor-driven policy (docs/observability.md "GoodputPolicy"):
+# "goodput" = cost-aware ride-out/shed + priced re-grow,
+# "naive_straggler" = the shed-on-first-spike baseline. The scenario
+# runner sets this to compare adaptation policies on one trace.
+POLICY = os.environ.get("KF_POLICY", "")
 RECOVER = os.environ.get("KF_RECOVER", "0") == "1"
 RECOVERY_DEADLINE_S = float(
     os.environ.get("KF_RECOVERY_DEADLINE_MS", "30000")) / 1e3
@@ -73,7 +79,7 @@ RECOVERY_DEADLINE_S = float(
 # saves every KF_CKPT_EVERY steps (docs/fault_tolerance.md)
 CKPT_DIR = os.environ.get("KF_CKPT_DIR", "")
 CKPT_EVERY = int(os.environ.get("KF_CKPT_EVERY", "4"))
-BATCH = 64
+BATCH = int(os.environ.get("TEST_DEVICE_BATCH", "64"))
 LR = 0.1
 
 peer = kungfu_tpu.init()
@@ -95,8 +101,31 @@ def loss_and_grads(params, batch):
     return jax.value_and_grad(loss_fn)(params)
 
 
-elastic = ElasticCallback(peer, schedule=SCHEDULE,
-                          samples_per_step=BATCH)
+policy = None
+if POLICY:
+    from kungfu_tpu.elastic.policy import (GoodputPolicy,
+                                           NaiveStragglerPolicy)
+
+    if POLICY == "goodput":
+        policy = GoodputPolicy()
+    elif POLICY == "naive_straggler":
+        policy = NaiveStragglerPolicy()
+    else:
+        # a typo'd policy silently running the wrong baseline would
+        # corrupt every comparison derived from this run
+        raise SystemExit(f"unknown KF_POLICY {POLICY!r} "
+                         "(known: goodput, naive_straggler)")
+# a policy run is monitor-driven: the schedule must not also steer
+# (ElasticCallback consults the policy only when no schedule is set)
+elastic = ElasticCallback(peer, schedule="" if policy else SCHEDULE,
+                          samples_per_step=BATCH, policy=policy)
+
+# the live goodput families (kf_goodput_ratio, kf_useful_ms_total,
+# kf_lost_ms_total{phase=...}): fed per step below, read back by the
+# policies and scraped via /metrics (trace/goodput.py)
+from kungfu_tpu.trace.goodput import GoodputMeter
+
+meter = GoodputMeter()
 
 # KF_GRAD_BUCKET_MB > 0 switches the gradient all-reduce from the
 # monolithic lump to the bucketed, overlapped pipeline (compression
@@ -137,10 +166,14 @@ def maybe_save():
     if ckpt is None or CKPT_EVERY <= 0 \
             or elastic.state.step % CKPT_EVERY != 0:
         return
+    t0 = time.perf_counter()
     g = ckpt.save(
         (params, opt_state), step=elastic.state.step,
         meta={"trained_samples": elastic.state.trained_samples},
         residual=pipe.state() if pipe is not None else None)
+    # only the synchronous snapshot stall is exposed overhead; the
+    # writer thread's wall rides the ckpt.save span instead
+    meter.observe("checkpoint", (time.perf_counter() - t0) * 1e3)
     print(f"KF_CKPT_SAVED gen={g} step={elastic.state.step} "
           f"rank={peer.rank}", flush=True)
 
@@ -216,6 +249,13 @@ else:
         elastic.state.step = int(step0)
         elastic.state.trained_samples = int(
             meta0.get("trained_samples", 0))
+        # the goodput plane's lost-work anchor: any step computed
+        # BEFORE this instant and PAST this generation was discarded
+        # by the whole-cluster death (trace/goodput.py; the victims'
+        # own flight dumps supply those spans)
+        trace.set_context(rank=peer.rank, version=peer.version,
+                          step=int(step0))
+        trace.event("ckpt.restored", cat="ckpt", gen_step=int(step0))
         if pipe is not None:
             if residual0 is not None:
                 # survivor semantics: this rank ran in the saving
@@ -256,8 +296,10 @@ def try_recover():
     global params, opt_state, sampler, pending_continuity, just_recovered
     print(f"KF_RECOVERY_CAUGHT rank={peer.rank} "
           f"step={elastic.state.step}", flush=True)
+    t_rec0 = time.perf_counter()
     out = elastic.recover(params=(params, opt_state),
                           deadline_s=RECOVERY_DEADLINE_S)
+    meter.observe("recovery", (time.perf_counter() - t_rec0) * 1e3)
     if out is None:
         if not elastic.state.keep:
             # the recovery stage evicted US — a legitimate outcome,
@@ -277,6 +319,12 @@ def try_recover():
 
 last_loss = None
 pending_continuity = None  # survivor's pre-resize/pre-recovery loss
+# bind the step context before the first span: a compute span tagged
+# step=k is the computation OF step k+1 on every boot path — fresh
+# init (0), joiner (synced position), cold restore (generation step) —
+# so the goodput plane's step normalization holds uniformly
+trace.set_context(rank=peer.rank, version=peer.version,
+                  step=elastic.state.step)
 while elastic.state.step < TOTAL_STEPS:
     t_step0 = time.perf_counter()
     idx = sampler.next_indices()
@@ -287,9 +335,11 @@ while elastic.state.step < TOTAL_STEPS:
     # pipeline), hook (schedule/consensus poll). Spans wrap the CALL
     # SITES; nothing records inside the jitted body (the trace-purity
     # lint holds the whole tree to that).
+    t_compute0 = time.perf_counter()
     with trace.span("step.compute", cat="step"):
         loss, grads = loss_and_grads(params, batch)
         loss = float(loss)
+    t_compute = time.perf_counter()
     try:
         with trace.span("step.grad_wire", cat="step"):
             if pipe is not None:
@@ -305,6 +355,17 @@ while elastic.state.step < TOTAL_STEPS:
             raise
         try_recover()
         continue  # redo this step in the shrunken epoch
+    # feed the live goodput families BEFORE after_step so a policy
+    # consulted there sees THIS step's wire wait (a straggler spike
+    # must be actionable the step it happens, not one step late)
+    # compute is measured over the step.compute span's window (not
+    # from t_step0) so the live kf_useful_ms_total agrees with what
+    # the offline taxonomy bills as compute; sampling/batch assembly
+    # stays unattributed in both planes
+    t_wire = time.perf_counter()
+    meter.observe_step(
+        compute_ms=(t_compute - t_compute0) * 1e3,
+        wire_ms=(t_wire - t_compute) * 1e3)
     if just_recovered:
         # first data-plane collective of the recovered epoch succeeded:
         # this closes the MTTR window the recovery benchmark measures
@@ -327,6 +388,10 @@ while elastic.state.step < TOTAL_STEPS:
         pending_continuity = None
     last_loss = loss
 
+    if policy is not None:
+        # the amortization horizon for priced re-grows
+        policy.observe_progress(elastic.state.step, TOTAL_STEPS)
+    t_hook0 = time.perf_counter()
     try:
         with trace.span("step.hook", cat="step"):
             changed = elastic.after_step()
@@ -337,12 +402,20 @@ while elastic.state.step < TOTAL_STEPS:
             raise
         try_recover()
         continue
+    meter.observe("hook", (time.perf_counter() - t_hook0) * 1e3)
     if changed:
         if not elastic.state.keep:
             print(f"evicted at step {elastic.state.step}", flush=True)
             raise SystemExit(0)
-        elastic.sync_position()
-        params = broadcast_variables(params, peer=peer)
+        # one resize.resync span per planned epoch switch, so the
+        # goodput plane bills the resync to "resize" instead of
+        # leaving it in the unattributed residual
+        t_rs0 = time.perf_counter()
+        with trace.span("resize.resync", cat="elastic",
+                        size=peer.size):
+            elastic.sync_position()
+            params = broadcast_variables(params, peer=peer)
+        meter.observe("resize", (time.perf_counter() - t_rs0) * 1e3)
         sampler = make_sampler()
         make_checkpointer()  # rank/size changed: rebind the schedule
         pending_continuity = last_loss

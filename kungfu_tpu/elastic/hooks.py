@@ -126,6 +126,10 @@ class ElasticCallback:
                               version=self.peer.version)
             trace.event("resize.adopted", cat="elastic",
                         size=self.peer.size, keep=keep)
+        # straggler sleeps fire AFTER the consensus round so a slow
+        # host is late to the next step's gradient all-reduce, not to
+        # the control-plane barrier above (kungfu_tpu/chaos.py)
+        chaos.on_step_end(self.peer.rank, st.step)
         return changed
 
     # -- survivor-driven failure recovery ------------------------------------

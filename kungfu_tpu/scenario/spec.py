@@ -1,0 +1,283 @@
+"""Declarative cluster-availability scenarios: the trace-in format.
+
+A **scenario** is the replayable description of what a real cluster
+lived through — spot preemptions (with the fabric's lead-time
+warning), diurnal grow/shrink curves, slow hosts, flaky control
+planes, partitioned networks — expressed as events over a **step
+timeline** (plus wall-clock offsets for the one fault class that is
+genuinely wall-clock-shaped, netns link flaps). The reference's
+adaptation benchmarks hand-author docker-compose churn scripts
+(reference: benchmarks/adaptation/gen-compose.py); here the scenario
+is data: JSON in a file or inline env, schedule-only, so every rank —
+and every future replay — derives the identical plan
+(`compiler.compile_scenario` is held to that by the kfverify
+schedule-purity pass).
+
+Spec format::
+
+    {"name": "spot2", "np0": 2, "steps": 14, "device_batch": 64,
+     "seed": 0,
+     "events": [
+       {"kind": "preempt", "step": 8, "scope": "cluster",
+        "lead_steps": 2},                      # spot reclaim, whole host
+       {"kind": "preempt", "step": 5, "rank": 2},   # one worker dies
+       {"kind": "resize", "step": 4, "size": 3},    # diurnal points
+       {"kind": "straggler", "step": 4, "rank": 1,
+        "duration_steps": 4, "ms": 120},
+       {"kind": "flaky_control", "step": 3, "requests": 4,
+        "mode": "delay", "ms": 150},          # config server degrades
+       {"kind": "partition", "host": "a", "at_ms": 3000,
+        "heal_ms": 5500}                      # netns link flap
+     ],
+     "env": {"KF_CKPT_EVERY": "3"}}
+
+Event kinds (each validated by `load_scenario`):
+
+- ``preempt`` — ``scope: "cluster"`` (default when no rank) kills
+  every worker at ``step`` (the spot-reclaim shape; the run must then
+  cold-restore from the durable checkpoint tier), a pinned ``rank``
+  kills one worker (survivor recovery handles it). ``lead_steps``
+  schedules a `preempt_warning` chaos marker that many steps earlier.
+- ``resize`` — the cluster-size timeline changes to ``size`` at
+  ``step`` (diurnal availability curves are a list of these).
+- ``straggler`` — ``rank`` sleeps ``ms`` per step for
+  ``duration_steps`` steps starting at ``step`` (the
+  `benchmarks/straggler.py` slow-host mechanism, injected through the
+  chaos engine so it rides any trainer).
+- ``flaky_control`` — the config server degrades for ``requests``
+  requests starting roughly at ``step``: ``mode: "delay"`` adds
+  ``ms`` per request, ``mode: "refuse"`` returns ``status`` (503).
+- ``partition`` — netns link flap on fake host ``host`` between
+  wall offsets ``at_ms`` and ``heal_ms`` (needs the FakeNet fabric;
+  the chaos matrix runs these, everything else runs anywhere).
+
+`CANNED` holds the standard trace suite (docs/fault_tolerance.md):
+builders parameterized by cluster size so the goodput benchmark can
+sweep np.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+_EVENT_KINDS = ("preempt", "resize", "straggler", "flaky_control",
+                "partition")
+
+_REQUIRED = {
+    "preempt": ("step",),
+    "resize": ("step", "size"),
+    "straggler": ("step", "rank", "duration_steps", "ms"),
+    "flaky_control": ("step", "requests"),
+    "partition": ("host", "at_ms", "heal_ms"),
+}
+
+
+@dataclass
+class Scenario:
+    """A validated scenario spec. Plain data: nothing here may read
+    clocks, env or tensors — the compiler derives the whole plan from
+    these fields alone."""
+
+    name: str
+    np0: int
+    steps: int
+    events: List[Dict] = field(default_factory=list)
+    device_batch: int = 64
+    seed: int = 0
+    env: Dict[str, str] = field(default_factory=dict)
+    description: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "name": self.name, "np0": self.np0, "steps": self.steps,
+            "events": self.events, "device_batch": self.device_batch,
+            "seed": self.seed, "env": self.env,
+            "description": self.description,
+        }, sort_keys=True)
+
+
+def load_scenario(spec) -> Scenario:
+    """Parse + validate a scenario from a dict, JSON string, file path
+    or canned name. Raises ValueError on anything malformed — a
+    scenario that half-parses would replay a different trace than the
+    one the operator recorded."""
+    if isinstance(spec, Scenario):
+        return spec
+    if isinstance(spec, str):
+        if spec in CANNED:
+            return CANNED[spec]()
+        if os.path.exists(spec):
+            with open(spec, encoding="utf-8") as fh:
+                spec = fh.read()
+        try:
+            spec = json.loads(spec)
+        except ValueError as e:
+            raise ValueError(
+                f"scenario: not a canned name, file or JSON "
+                f"({sorted(CANNED)} are canned): {e}") from e
+    if not isinstance(spec, dict):
+        raise ValueError(f"scenario: expected an object, got "
+                         f"{type(spec).__name__}")
+    name = spec.get("name")
+    if not name or not isinstance(name, str):
+        raise ValueError("scenario: 'name' (string) is required")
+    np0 = int(spec.get("np0", 0))
+    steps = int(spec.get("steps", 0))
+    if np0 <= 0 or steps <= 0:
+        raise ValueError(
+            f"scenario {name!r}: np0 and steps must be positive "
+            f"(np0={np0}, steps={steps})")
+    events = spec.get("events", [])
+    if not isinstance(events, list):
+        raise ValueError(f"scenario {name!r}: 'events' must be a list")
+    for n, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"scenario {name!r}: event {n} is not an "
+                             "object")
+        kind = ev.get("kind")
+        if kind not in _EVENT_KINDS:
+            raise ValueError(
+                f"scenario {name!r}: event {n} has unknown kind "
+                f"{kind!r} (known: {_EVENT_KINDS})")
+        for key in _REQUIRED[kind]:
+            if key not in ev:
+                raise ValueError(
+                    f"scenario {name!r}: {kind} event {n} is missing "
+                    f"required field {key!r}")
+        if "step" in ev and not 0 <= int(ev["step"]) <= steps:
+            raise ValueError(
+                f"scenario {name!r}: {kind} event {n} step "
+                f"{ev['step']} outside [0, {steps}]")
+    env = spec.get("env", {})
+    if not isinstance(env, dict) or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in env.items()):
+        raise ValueError(f"scenario {name!r}: 'env' must map str->str")
+    return Scenario(
+        name=name, np0=np0, steps=steps,
+        events=[dict(e) for e in events],
+        device_batch=int(spec.get("device_batch", 64)),
+        seed=int(spec.get("seed", 0)),
+        env=dict(env),
+        description=str(spec.get("description", "")),
+    )
+
+
+# -- the standard trace suite -------------------------------------------------
+
+def spot_preempt(np0: int = 2) -> Scenario:
+    """Spot reclaim of the whole allocation: the fabric warns 2 steps
+    ahead, every worker is SIGKILLed at step 8, and the replacement
+    allocation cold-boots from the durable checkpoint tier. The
+    shortest canned scenario — the run-all.sh goodput gate replays it
+    at np0=2. Lost work = the steps past the last complete generation,
+    attributed from the victims' flight-recorder dumps."""
+    return load_scenario({
+        "name": "spot_preempt", "np0": np0, "steps": 12,
+        "events": [
+            {"kind": "preempt", "step": 8, "scope": "cluster",
+             "lead_steps": 2},
+        ],
+        "env": {"KF_CKPT_EVERY": "3"},
+        "description": "whole-allocation spot reclaim at step 8 "
+                       "(2-step warning), cold restore from the "
+                       "sharded checkpoint tier",
+    })
+
+
+def spot_kill_regrow(np0: int = 3) -> Scenario:
+    """One worker preempted mid-step: survivors shrink through the
+    recovery state machine, the schedule observes size < target and
+    re-grows through the ordinary elastic path. Lost work = the
+    survivors' discarded attempt at the failed step."""
+    return load_scenario({
+        "name": "spot_kill_regrow", "np0": np0, "steps": 12,
+        "events": [
+            {"kind": "preempt", "step": 5, "rank": np0 - 1,
+             "lead_steps": 1},
+        ],
+        "description": "spot-preempt one worker at step 5; survivor "
+                       "recovery + schedule-driven re-grow",
+    })
+
+
+def diurnal(np0: int = 2) -> Scenario:
+    """Diurnal availability: capacity grows by one mid-run and drains
+    back — the grow/shrink curve every preemptible pool walks daily.
+    Pure planned resizes: the goodput decomposition prices the
+    resync/adopt cost of following the curve."""
+    return load_scenario({
+        "name": "diurnal", "np0": np0, "steps": 15,
+        "events": [
+            {"kind": "resize", "step": 5, "size": np0 + 1},
+            {"kind": "resize", "step": 10, "size": np0},
+        ],
+        "description": "grow to np0+1 at step 5, drain back at "
+                       "step 10 (diurnal availability curve)",
+    })
+
+
+def straggler_transient(np0: int = 2) -> Scenario:
+    """A transient slow host: the last rank sleeps 8x a clean CPU step
+    for 4 steps, then recovers (thermal throttle / noisy neighbour
+    shape). The policy question this scenario poses: pay a resize to
+    shed the straggler, or ride it out? (`GoodputPolicy` vs
+    `NaiveStragglerPolicy`, docs/fault_tolerance.md)."""
+    return load_scenario({
+        "name": "straggler_transient", "np0": np0, "steps": 14,
+        "events": [
+            {"kind": "straggler", "step": 5, "rank": np0 - 1,
+             "duration_steps": 4, "ms": 120},
+        ],
+        "description": "rank np0-1 sleeps 120 ms/step for steps 5-8, "
+                       "then recovers",
+    })
+
+
+def flaky_control(np0: int = 2) -> Scenario:
+    """A flapping control plane: the config server delays then refuses
+    requests mid-run. Training must ride the retry policy through it;
+    goodput shows what the degradation cost."""
+    return load_scenario({
+        "name": "flaky_control", "np0": np0, "steps": 12,
+        "events": [
+            {"kind": "flaky_control", "step": 3, "requests": 4,
+             "mode": "delay", "ms": 150},
+            {"kind": "flaky_control", "step": 7, "requests": 2,
+             "mode": "refuse", "status": 503},
+        ],
+        "description": "config server delays 4 requests then refuses "
+                       "2 mid-run; the retry policy bridges it",
+    })
+
+
+def flaky_net(np0: int = 2) -> Scenario:
+    """A flapping physical link: netns fake host 'a' drops its uplink
+    for 2.5 s mid-run and heals inside the failure-detection deadline.
+    Needs the FakeNet fabric (root + CAP_NET_ADMIN) — the chaos
+    matrix member of the suite."""
+    return load_scenario({
+        "name": "flaky_net", "np0": np0, "steps": 40,
+        "events": [
+            {"kind": "partition", "host": "a", "at_ms": 3000,
+             "heal_ms": 5500},
+        ],
+        "description": "veth link down 3.0-5.5 s into the run; TCP "
+                       "retransmits bridge the flap (netns only)",
+    })
+
+
+#: the standard trace suite: name -> builder(np0). `benchmarks/
+#: goodput.py` sweeps these across cluster sizes and publishes the
+#: decomposition rows to BASELINE; run-all.sh gates on the first.
+CANNED = {
+    "spot_preempt": spot_preempt,
+    "spot_kill_regrow": spot_kill_regrow,
+    "diurnal": diurnal,
+    "straggler_transient": straggler_transient,
+    "flaky_control": flaky_control,
+    "flaky_net": flaky_net,
+}

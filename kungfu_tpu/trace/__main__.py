@@ -3,6 +3,7 @@
     python -m kungfu_tpu.trace --dir $KF_TRACE_DIR -o trace.json
     python -m kungfu_tpu.trace --server http://host:9100 -o trace.json
     python -m kungfu_tpu.trace --dir D --summary
+    python -m kungfu_tpu.trace --dir D --goodput
     python -m kungfu_tpu.trace --validate trace.json
 
 ``--dir`` reads flight-recorder JSONL files, ``--server`` fetches the
@@ -10,12 +11,17 @@ config server's collected ``/trace`` snapshot; both may be combined
 (events deduplicate on the per-process ``(nonce, id)`` key). The
 output is Chrome trace-event JSON — load it at https://ui.perfetto.dev
 or chrome://tracing. ``--summary`` prints the cluster timeline
-(per-rank span totals, chaos/recovery landmarks, and — when a
-recovery rode the window — the MTTR decomposition). ``--validate``
+(per-rank span totals, per-rank wallclock span coverage, chaos/
+recovery landmarks, and — when a recovery rode the window — the MTTR
+decomposition). ``--goodput`` prints the goodput decomposition (text
+table + JSON; docs/observability.md) and exits nonzero when the
+phase-sum invariant is violated or no useful step survived — the
+scenario-replay CI gate (scripts/run-all.sh). ``--validate``
 schema-checks an exported file and exits nonzero on malformed output;
-the CI smoke gates on it (scripts/run-all.sh).
+the CI smoke gates on it.
 
-Exit codes: 0 ok, 1 validation failure / no events, 2 usage error.
+Exit codes: 0 ok, 1 validation/invariant failure / no events, 2 usage
+error.
 """
 
 from __future__ import annotations
@@ -42,6 +48,15 @@ def main(argv=None) -> int:
                     help="write Chrome trace JSON here")
     ap.add_argument("--summary", action="store_true",
                     help="print the cluster timeline summary (JSON)")
+    ap.add_argument("--goodput", action="store_true",
+                    help="print the goodput phase decomposition "
+                         "(table + JSON); exit 1 on invariant failure")
+    ap.add_argument("--tolerance", type=float, default=5.0,
+                    help="goodput invariant tolerance, %% of wall "
+                         "(default 5)")
+    ap.add_argument("--device-batch", type=int, default=64,
+                    help="samples per rank-step for useful-sample "
+                         "goodput (default 64: the continuity trainer)")
     ap.add_argument("--validate", metavar="TRACE_JSON",
                     help="schema-check an exported trace file and exit")
     args = ap.parse_args(argv)
@@ -81,6 +96,20 @@ def main(argv=None) -> int:
         print("kftrace: no events found (was the run launched with "
               "KF_TRACE=1 and KF_TRACE_DIR set?)", file=sys.stderr)
         return 1
+
+    if args.goodput:
+        from .goodput import decompose, format_table
+
+        decomp = decompose(sources, tolerance_pct=args.tolerance,
+                           device_batch=args.device_batch)
+        print(format_table(decomp))
+        print(json.dumps(decomp, indent=2))
+        if not decomp["invariant"]["ok"]:
+            print("kftrace: GOODPUT INVARIANT VIOLATED (phases do "
+                  "not sum to wallclock within tolerance, or no "
+                  "useful step survived)", file=sys.stderr)
+            return 1
+        return 0
 
     if args.summary or not args.output:
         print(json.dumps(summarize(events, info), indent=2))

@@ -89,14 +89,17 @@ def fetch_server(url: str, timeout_s: float = 5.0) -> List[Dict]:
     return out
 
 
-def merge_sources(sources: List[Dict]) -> Tuple[List[Dict], Dict]:
+def merge_sources(sources: List[Dict],
+                  keep_nonce: bool = False) -> Tuple[List[Dict], Dict]:
     """Deduplicate and time-order events from every source.
 
     Returns ``(events, info)``: each event gains a ``role`` (from its
     source header) and the info dict aggregates drop counts. Dedup key
     is ``(nonce, event-id)`` — the recorder's per-process sequence —
     so the same event arriving via a flight dump AND a shipped batch
-    counts once."""
+    counts once. ``keep_nonce`` stamps each event with its source
+    ``_nonce`` for consumers that need to know which process boot an
+    event belongs to (the goodput plane's per-phase active windows)."""
     seen = set()
     events: List[Dict] = []
     dropped = 0
@@ -115,6 +118,8 @@ def merge_sources(sources: List[Dict]) -> Tuple[List[Dict], Dict]:
             seen.add(key)
             e = dict(ev)
             e.setdefault("role", role)
+            if keep_nonce:
+                e["_nonce"] = str(nonce)
             events.append(e)
     events.sort(key=lambda e: (e.get("ts", 0), -e.get("dur", 0)))
     return events, {"sources": len(sources),
@@ -295,9 +300,47 @@ def recovery_decomposition(events: List[Dict]
     }
 
 
+def span_coverage(events: List[Dict]) -> Dict:
+    """Per-rank wallclock span coverage: what fraction of the run's
+    window each rank's spans actually account for.
+
+    The guard rail in front of every goodput number: a rank whose
+    trace covers 40% of the run (ring overflow dropped its early
+    spans, a crash lost a dump, collection missed a batch) will
+    produce a goodput decomposition dominated by unattributed time —
+    this line makes that visible BEFORE anyone trusts the ratio.
+    Returns ``{"run_ms": window, "per_rank": {rank: {"span_ms",
+    "pct_of_run"}}}`` over worker ranks; span unions clip nested and
+    overlapping spans so coverage never exceeds 100%."""
+    lo = min((e["ts"] for e in events), default=0)
+    hi = max((e["ts"] + e.get("dur", 0) for e in events), default=0)
+    run_us = max(0, hi - lo)
+    spans_by_rank: Dict[int, List[Tuple[int, int]]] = {}
+    for e in events:
+        rank = e.get("rank", -1)
+        if (e.get("ph") == "X" and isinstance(rank, int) and rank >= 0
+                and e.get("role", "worker") == "worker"):
+            spans_by_rank.setdefault(rank, []).append(
+                (e["ts"], e["ts"] + e.get("dur", 0)))
+    per_rank = {}
+    for rank, spans in sorted(spans_by_rank.items()):
+        covered, cur = 0, lo
+        for t0, t1 in sorted(spans):
+            s, t = max(cur, t0), max(cur, t1)
+            covered += t - s
+            cur = max(cur, t1)
+        per_rank[str(rank)] = {
+            "span_ms": round(covered / 1e3, 1),
+            "pct_of_run": round(100.0 * covered / run_us, 1)
+            if run_us else 0.0,
+        }
+    return {"run_ms": round(run_us / 1e3, 1), "per_rank": per_rank}
+
+
 def summarize(events: List[Dict], info: Optional[Dict] = None) -> Dict:
     """Cluster timeline summary: per-rank span totals by name, step
-    range, chaos/recovery landmarks — the text view of the trace."""
+    range, per-rank wallclock span coverage, chaos/recovery landmarks
+    — the text view of the trace."""
     per_rank: Dict = {}
     landmarks: List[Dict] = []
     steps = [e.get("step", -1) for e in events
@@ -325,6 +368,9 @@ def summarize(events: List[Dict], info: Optional[Dict] = None) -> Dict:
                                                      key=lambda kv:
                                                      str(kv[0]))},
         "landmarks": sorted(landmarks, key=lambda d: d["t_ms"]),
+        # incomplete traces must be visible BEFORE a goodput number
+        # derived from them is trusted (docs/observability.md)
+        "coverage": span_coverage(events),
     }
     rec = recovery_decomposition(events)
     if rec is not None:

@@ -140,6 +140,21 @@ class Registry:
         with self._mu:
             self._metrics.clear()
 
+    def read(self, name: str, **labels) -> float:
+        """Current value of a family cell: counter/gauge value, or a
+        histogram's running sum. 0.0 when the cell never existed —
+        readers (e.g. `GoodputPolicy` diffing per-step deltas) treat
+        absent families as silent zeros, matching how components
+        update metrics unconditionally but optionally."""
+        key_labels = tuple(sorted(labels.items()))
+        with self._mu:
+            for kind in ("counter", "gauge", "histogram"):
+                m = self._metrics.get((kind, name, key_labels))
+                if m is not None:
+                    return float(m.total if kind == "histogram"
+                                 else m.value)
+        return 0.0
+
     # -- rendering -----------------------------------------------------------
 
     def render(self, extra_labels: Optional[Dict[str, str]] = None

@@ -48,6 +48,22 @@ _ENV_ENABLE = "KF_TRACE"
 _ENV_DIR = "KF_TRACE_DIR"
 _ENV_RING = "KF_TRACE_RING"
 
+#: per-process recorder sequence, folded into the nonce: pid+wall-ms
+#: alone collide when two recorders are created in the same process
+#: within one clock tick (a worker recorder next to a runner-role
+#: one, or configure() swapping recorders mid-process) — and a
+#: collided nonce makes merge_sources dedup the second recorder's
+#: events away, silently losing wall from the goodput decomposition
+_nonce_mu = threading.Lock()
+_nonce_seq = 0  # kf: guarded_by(_nonce_mu)
+
+
+def _next_nonce_seq() -> int:
+    global _nonce_seq
+    with _nonce_mu:
+        _nonce_seq += 1
+        return _nonce_seq
+
 
 class _NoopSpan:
     """Shared zero-cost span for the disabled path."""
@@ -134,7 +150,8 @@ class TraceRecorder:
         self._ctx: Dict[str, int] = {"rank": -1, "version": 0,
                                      "step": -1}
         self._ship = None  # collect.TraceShipper queue, if attached
-        self.nonce = f"{os.getpid()}-{int(self._wall0 * 1e3) % 10**9}"
+        self.nonce = (f"{os.getpid()}-{int(self._wall0 * 1e3) % 10**9}"
+                      f"-{_next_nonce_seq()}")
 
     # -- clock ---------------------------------------------------------------
 

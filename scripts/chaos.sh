@@ -17,22 +17,33 @@ export JAX_PLATFORMS=cpu
 export KF_LOG_LEVEL=${KF_LOG_LEVEL:-warn}
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/3] deterministic chaos subset (tier-1 members) =="
+echo "== [1/4] deterministic chaos subset (tier-1 members) =="
 python -m pytest tests/test_chaos.py tests/test_retrying.py \
   tests/test_failure_injection.py -q -m 'not slow' -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-  echo "== fast mode: netns matrix + MTTR benchmark skipped =="
+  echo "== fast mode: netns matrix + scenario suite + benchmark skipped =="
   exit 0
 fi
 
-echo "== [2/3] netns fault matrix (partition heal, host churn, host death) =="
+echo "== [2/4] netns fault matrix (partition heal, host churn, host death) =="
 # the netns members self-skip without root + CAP_NET_ADMIN
 python -m pytest tests/test_failure_injection.py tests/test_churn.py \
   -q -m 'slow' -p no:cacheprovider
 python -m pytest tests/test_multirunner.py -q -p no:cacheprovider
 
-echo "== [3/3] MTTR benchmark =="
+echo "== [3/4] scenario trace suite: full canned matrix + goodput decomposition =="
+# every loopback-replayable canned scenario (docs/fault_tolerance.md
+# "scenario suite") through the real runtime, each gated on the
+# goodput phase-sum invariant, plus the slow/chaos-marked replay
+# members (spot-preempt accounting, policy comparison). flaky_net
+# rides the netns matrix above (test_churn) — the runner refuses
+# netns windows on loopback by design (ScenarioUnsupported).
+python -m pytest tests/test_scenario.py tests/test_policy.py \
+  -q -m 'slow' -p no:cacheprovider
+python -m kungfu_tpu.benchmarks.goodput --np 2 3 4
+
+echo "== [4/4] MTTR benchmark =="
 python -m kungfu_tpu.benchmarks.recovery --runs 3
 
 echo "CHAOS MATRIX GREEN"

@@ -23,6 +23,10 @@ echo "== [0/7] lint: kflint + kfverify (+ruff/mypy when available) =="
 # stricter pass lands with debt.)
 JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis kungfu_tpu/ \
   --baseline scripts/kflint_baseline.json
+# every round must publish its headline metric (BENCH_rNN.json); a
+# round that only touched BASELINE.json leaves the perf-trajectory
+# feed blind — fail loudly and early (benchmarks/publish.py)
+JAX_PLATFORMS=cpu python -m kungfu_tpu.benchmarks.publish --check-round
 # pyproject.toml carries the ruff/mypy baselines; the container doesn't
 # ship them, so they gate only where installed (dev machines, CI)
 if python -c "import ruff" 2>/dev/null; then
@@ -154,6 +158,27 @@ for args in (["--dir", d, "-o", out], ["--validate", out]):
     if r.returncode:
         sys.exit(f"kftrace smoke failed at {' '.join(args)}")
 print("KFTRACE SMOKE OK")
+EOF
+
+echo "== [4e/7] goodput gate: shortest canned scenario replay -> phase-sum invariant =="
+# the operator-facing number (docs/observability.md "goodput"): replay
+# the shortest canned scenario (spot_preempt @ np0=2: whole-allocation
+# kill at step 8, cold restore from the sharded checkpoint tier) under
+# KF_TRACE=1 and gate on `--goodput` — the decomposition must sum to
+# rank-active wallclock within tolerance and attribute the victims'
+# lost steps from their flight dumps, or this stage exits nonzero.
+# The full scenario x np matrix is scripts/chaos.sh territory.
+timeout 300 python - <<'EOF'
+import subprocess, sys, tempfile
+from kungfu_tpu.scenario import run_scenario
+d = tempfile.mkdtemp(prefix="kf-goodput-smoke-")
+run = run_scenario("spot_preempt", trace_dir=d + "/trace",
+                   port_range="26000-26999")
+r = subprocess.run([sys.executable, "-m", "kungfu_tpu.trace",
+                    "--dir", d + "/trace", "--goodput"])
+if r.returncode:
+    sys.exit("GOODPUT GATE FAILED: decomposition invariant violated")
+print("GOODPUT GATE OK")
 EOF
 
 echo "== [5/7] examples smoke =="

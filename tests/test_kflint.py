@@ -1083,6 +1083,65 @@ def test_schedule_purity_quiet_on_init_and_shapes():
     assert findings == []
 
 
+def test_schedule_purity_fires_on_impure_scenario_compiler():
+    """The scenario->ChaosSchedule compiler is a schedule function
+    (every rank replays the plan from its own env copy): a clock or
+    env read inside the lowering means two ranks replay DIFFERENT
+    traces — the same divergence class as a per-rank chunk layout."""
+    findings = fire_project(SchedulePurityPass(), **{"s.py": """
+        import os
+        import time
+
+        def compile_scenario(scenario):
+            jitter = time.time() % 1.0
+            lead = int(os.getenv("KF_LEAD_STEPS", "1"))
+            return {"faults": [{"type": "preempt_warning",
+                                "step": int(jitter * 10) + lead}]}
+    """})
+    msgs = " ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "compile_scenario" in msgs
+    assert "nondeterministic call" in msgs and "env read" in msgs
+
+
+def test_schedule_purity_fires_on_scenario_compiler_feeder():
+    # the argument side: a spec materialized from the environment at
+    # call time feeds the compiler — two ranks may compile different
+    # plans even though the lowering itself is pure
+    findings = fire_project(SchedulePurityPass(), **{"s.py": """
+        import os
+
+        def spec_from_env():
+            return {"steps": int(os.environ["KF_STEPS"])}
+
+        def replay():
+            spec = spec_from_env()
+            return compile_scenario(spec)
+    """})
+    assert len(findings) == 1
+    assert "compile_scenario" in findings[0].message
+    assert "env read" in findings[0].message
+
+
+def test_schedule_purity_quiet_on_pure_scenario_compiler():
+    # the shape the real compiler has: plan derived from the spec's
+    # fields alone (kungfu_tpu/scenario/compiler.py)
+    findings = fire_project(SchedulePurityPass(), **{"s.py": """
+        def compile_scenario(scenario):
+            faults = []
+            for ev in scenario["events"]:
+                if ev["kind"] == "preempt":
+                    faults.append({"type": "crash_worker",
+                                   "step": int(ev["step"])})
+            return {"seed": int(scenario.get("seed", 0)),
+                    "faults": faults}
+
+        def replay(spec):
+            return compile_scenario(spec)
+    """})
+    assert findings == []
+
+
 # -- kfverify: lock-order ----------------------------------------------------
 
 

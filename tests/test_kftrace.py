@@ -151,6 +151,24 @@ def test_flight_dump_roundtrip_and_dedup(tmp_path):
     assert validate_chrome_trace(doc) == []
 
 
+def test_same_process_recorders_never_share_a_nonce(tmp_path):
+    """Two recorders born in the same process within one clock tick
+    (worker + runner-role, or configure() swapping mid-process) must
+    NOT collide on the (nonce, id) dedup key — a collision makes
+    merge_sources silently drop the second recorder's events, which
+    for the goodput plane means unattributed (or worse, vanished)
+    wall. Regression: the pid+wall-ms nonce collided exactly here."""
+    recs = [trace.TraceRecorder(directory=str(tmp_path))
+            for _ in range(8)]
+    assert len({r.nonce for r in recs}) == len(recs)
+    for n, r in enumerate(recs):
+        r.event(f"ev{n}", cat="step")
+        r.dump()
+    events, _ = merge_sources(read_flight_dir(str(tmp_path)))
+    got = {e["name"] for e in events if e["name"].startswith("ev")}
+    assert got == {f"ev{n}" for n in range(8)}
+
+
 def test_chrome_trace_tracks_and_metadata(tmp_path):
     # worker process: nested spans on the rank-0 track
     rec = _enable(tmp_path)
