@@ -167,6 +167,7 @@ PASS_SPECS = (
     ("protocol.wire_names", "WireNameDeterminismPass"),
     ("protocol.collective_order", "CollectiveOrderPass"),
     ("protocol.schedule_purity", "SchedulePurityPass"),
+    ("protocol.strategy_graph", "StrategyGraphPass"),
     ("protocol.lock_order", "LockOrderPass"),
 )
 

@@ -19,6 +19,10 @@ fixture-test discipline):
 - ``schedule-purity``       — functions feeding ``chunk_schedule`` /
   ``bucket_schedule`` must be shape-only: no tensor-value reads, no
   env reads after init;
+- ``strategy-graph``        — communication-graph generators (the
+  ``gen_*`` topology family) must derive rank-identically from the
+  PeerList replica alone: no rank/host-identity, env, value or clock
+  reads (per-rank strategy graphs are a cross-rank deadlock);
 - ``lock-order``            — the whole-program lock acquisition graph
   (with-nests + call chains) must be acyclic.
 
@@ -34,6 +38,7 @@ from .collective_order import CollectiveOrderPass
 from .lock_order import LockOrderPass
 from .project import ProjectIndex
 from .schedule_purity import SchedulePurityPass
+from .strategy_graph import StrategyGraphPass
 from .wire_names import WireNameDeterminismPass
 
 __all__ = [
@@ -41,5 +46,6 @@ __all__ = [
     "LockOrderPass",
     "ProjectIndex",
     "SchedulePurityPass",
+    "StrategyGraphPass",
     "WireNameDeterminismPass",
 ]

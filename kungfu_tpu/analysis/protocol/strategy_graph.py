@@ -1,0 +1,111 @@
+"""strategy-graph: topology generators must derive rank-identically.
+
+The communication-graph generators (``plan/topology.py``'s ``gen_*``
+family, ``resolve_auto``, ``_local_masters``) are schedule data in the
+kfverify sense: every rank walks the SAME (reduce, bcast) graph pairs
+for a collective, derived independently from its own replica of the
+cluster-agreed PeerList — exactly the schedule-only discipline
+chunk/bucket/shard_schedule obey. A generator that smuggles in anything
+rank-local produces per-rank graphs, which is a cross-rank deadlock
+with no error message (rank A waits on an edge rank B never drew):
+
+- **rank/identity divergence** — reading ``.rank`` / ``.local_rank`` /
+  ``.self_id`` attributes, or host-identity calls
+  (``socket.gethostname``, ``platform.node``, ``os.getpid``,
+  ``os.uname``). The PeerList already encodes who is where; the
+  generator must consume THAT, never "who am I".
+  (``PeerList.rank(peer)`` as a *method call* is exempt: mapping a
+  peer to its index is a pure function of the replica.)
+- **env reads** — two ranks may be configured apart; transport/
+  topology flags go through the launcher's CONFIG_VARS forwarding and
+  are read once at session construction, never inside a generator.
+- **tensor-value / clock / RNG reads** — the same hazards
+  schedule-purity checks, with the same exemptions.
+
+The generators' own bodies are checked unconditionally, project-wide,
+so a divergent generator is caught wherever it is defined (the
+rank-divergent-graph fixture in tests/test_kflint.py is the canonical
+fire case; the shipped tree is the quiet case).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from ..core import Finding, dotted_name
+from .project import ProjectIndex
+from .schedule_purity import _violations
+
+NAME = "strategy-graph"
+
+#: the generator inventory: the ``gen_*`` convention (every topology
+#: generator and the strategy/hierarchy pair builders follow it) plus
+#: the named helpers they all share
+GRAPH_FUNC_NAMES = {"_local_masters", "resolve_auto"}
+
+#: identity attributes whose *read* (not method call) inside a
+#: generator means per-rank graphs
+_RANK_ATTRS = {"rank", "local_rank", "self_id", "self_rank"}
+
+#: host-identity calls: divergent by definition across a cluster
+_HOST_CALLS = {"socket.gethostname", "socket.gethostbyname",
+               "platform.node", "os.getpid", "os.uname"}
+
+
+def _is_graph_fn(name: str) -> bool:
+    return name.startswith("gen_") or name in GRAPH_FUNC_NAMES
+
+
+def _rank_violations(fn_node: ast.AST) -> List[Tuple[int, str]]:
+    """(line, description) of rank/host-identity reads in one body."""
+    out: List[Tuple[int, str]] = []
+    called = {id(n.func) for n in ast.walk(fn_node)
+              if isinstance(n, ast.Call)}
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Call):
+            cn = dotted_name(n.func) or ""
+            if cn in _HOST_CALLS or cn.split(".", 1)[-1] in _HOST_CALLS:
+                out.append((n.lineno, f"host-identity call {cn}()"))
+        elif isinstance(n, ast.Attribute):
+            if (n.attr in _RANK_ATTRS and isinstance(n.ctx, ast.Load)
+                    and id(n) not in called):
+                out.append((n.lineno,
+                            f"rank-identity read .{n.attr}"))
+    return out
+
+
+class StrategyGraphPass:
+    name = NAME
+    doc = ("rank/env/value reads inside communication-graph "
+           "generators (per-rank strategy graphs = cross-rank "
+           "deadlock)")
+
+    def run_project(self, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def report(src, line, msg):
+            key = (src.path, line, msg)
+            if key in seen:
+                return
+            seen.add(key)
+            f = src.finding(line, NAME, msg)
+            if f:
+                findings.append(f)
+
+        for fname in sorted(index.by_simple):
+            if not _is_graph_fn(fname):
+                continue
+            for info in index.by_simple.get(fname, ()):
+                hazards = (_violations(info.node)
+                           + _rank_violations(info.node))
+                for line, what in sorted(hazards):
+                    report(info.src, line,
+                           f"{what} inside graph generator {fname}() "
+                           "— every rank must derive the identical "
+                           "strategy graph from its PeerList replica "
+                           "alone; rank-local state here is a "
+                           "cross-rank deadlock")
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings

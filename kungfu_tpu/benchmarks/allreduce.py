@@ -35,7 +35,31 @@ import sys
 import tempfile
 import time
 
-STRATEGIES = ("RING", "BINARY_TREE_STAR", "AUTO")
+from ..plan.topology import STRATEGY_NAMES
+
+#: the full pluggable-graph catalog (PAPER.md §strategy) + AUTO —
+#: derived from the one canonical list so the sweep can never drift
+#: from what the runtime accepts
+STRATEGIES = STRATEGY_NAMES + ("AUTO",)
+
+#: transport cells for the link-class A/B (docs/collectives.md):
+#: env deltas that pin each wire class for colocated peers
+TRANSPORT_ENV = {
+    "shm": {},
+    "unix": {"KF_SHM": "0"},
+    "tcp": {"KF_SHM": "0", "KF_NO_UNIX_SOCKET": "1"},
+}
+
+
+def two_host_spec(np_: int) -> str:
+    """np ranks over two simulated loopback hosts (127.0.0.1 +
+    127.0.0.2), the layout the hierarchical rows use; np=2 stays on
+    one host (two singleton hosts would have no colocated pair to
+    decompose)."""
+    if np_ < 4:
+        return f"127.0.0.1:{np_}"
+    a = np_ // 2
+    return f"127.0.0.1:{a},127.0.0.2:{np_ - a}"
 
 
 def worker_main(model: str, epochs: int, warmup: int, fuse: bool,
@@ -172,8 +196,11 @@ def grad_worker_main(model: str, steps: int, warmup: int, pipeline: str,
                 for i, name in enumerate(sorted(grads))}
 
     exposed, step_ms, egress = [], [], []
+    link0 = None
     p.barrier()
     for it in range(warmup + steps):
+        if it == warmup:
+            link0 = p.link_stats()["egress"]
         eg0 = p.stats()["egress_bytes"]
         t0 = time.perf_counter()
         if pipeline == "lump":
@@ -187,8 +214,15 @@ def grad_worker_main(model: str, steps: int, warmup: int, pipeline: str,
             exposed.append((t1 - t0) * 1e3 - backward_ms)
             step_ms.append((t1 - t0) * 1e3)
             egress.append(p.stats()["egress_bytes"] - eg0)
+    link1 = p.link_stats()["egress"]
 
     if p.rank == 0:
+        # link-class attribution over the measured window: how many of
+        # this rank's bytes rode each of {tcp, unix, shm} per step —
+        # "socket egress" (tcp+unix) is what the shm transport must
+        # shrink on colocated traffic (docs/collectives.md)
+        by_link = {k: (link1[k] - (link0 or {}).get(k, 0)) / steps
+                   for k in link1}
         out = {
             "np": p.size,
             "model": model,
@@ -196,11 +230,16 @@ def grad_worker_main(model: str, steps: int, warmup: int, pipeline: str,
             "compress": compress,
             "buckets": pipe.num_buckets,
             "backward_ms": backward_ms,
+            "hier": bool(getattr(p, "hierarchical", False)),
             "model_mb": round(total_bytes / 2**20, 1),
             "payload_mb_per_step": round(
                 pipe.last_step_info["payload_bytes"] / 2**20, 2),
             "egress_mb_per_step": round(
                 sum(egress) / len(egress) / 2**20, 2),
+            "egress_by_link_mb_per_step": {
+                k: round(v / 2**20, 2) for k, v in by_link.items()},
+            "socket_egress_mb_per_step": round(
+                (by_link["tcp"] + by_link["unix"]) / 2**20, 2),
             "exposed_comm_ms": round(
                 sorted(exposed)[len(exposed) // 2], 1),
             "step_ms": round(sorted(step_ms)[len(step_ms) // 2], 1),
@@ -215,10 +254,67 @@ def grad_worker_main(model: str, steps: int, warmup: int, pipeline: str,
     p.stop()
 
 
+def _launch_cluster(worker_args, np_: int, port_range: str, td: str,
+                    env: dict, hosts: str = "", strategy: str = "",
+                    timeout: float = 600.0) -> None:
+    """Run one benchmark cluster to completion.
+
+    With `hosts` empty: one kfrun spawning all np workers locally.
+    With a multi-host spec (e.g. "127.0.0.1:2,127.0.0.2:2"): one kfrun
+    per listed host ip, each with ``-self`` (kfrun only spawns the
+    workers scheduled on its own host — the test_multirunner shape),
+    all sharing the port range; loopback aliases make the 'hosts' real
+    to every colocated_with check. Raises with both runners' tails on
+    failure.
+    """
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    base = [sys.executable, "-m", "kungfu_tpu.run", "-np", str(np_),
+            "-port-range", port_range,
+            "-logdir", os.path.join(td, "logs"), "-q"]
+    if strategy:
+        base += ["-strategy", strategy]
+    ips = ([h.split(":")[0] for h in hosts.split(",")] if hosts
+           and "," in hosts else [""])
+    procs = []
+    for ip in ips:
+        cmd = list(base)
+        if hosts:
+            cmd += ["-H", hosts]
+        if ip:
+            cmd += ["-self", ip]
+        cmd += ["--"] + worker_args
+        out = open(os.path.join(td, f"runner-{ip or 'local'}.out"), "w")
+        procs.append((ip, out, subprocess.Popen(
+            cmd, env=env, cwd=repo, stdout=out,
+            stderr=subprocess.STDOUT, text=True)))
+    deadline = time.monotonic() + timeout
+    codes = {}
+    try:
+        for ip, _out, p in procs:
+            left = max(1.0, deadline - time.monotonic())
+            codes[ip] = p.wait(timeout=left)
+    finally:
+        for _ip, out, p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            out.close()
+    if any(codes.values()):
+        tails = []
+        for ip, _out, _p in procs:
+            path = os.path.join(td, f"runner-{ip or 'local'}.out")
+            with open(path) as f:
+                tails.append(f"[{ip or 'local'} rc={codes.get(ip)}] "
+                             + f.read()[-1500:])
+        raise RuntimeError("cluster failed:\n" + "\n".join(tails))
+
+
 def run_grad_one(np_: int, model: str, steps: int, warmup: int,
                  pipeline: str, compress: str, backward_ms: float,
                  bucket_mb: float, port_range: str,
-                 timeout: float = 600.0) -> dict:
+                 timeout: float = 600.0, hosts: str = "",
+                 extra_env: dict = None, strategy: str = "") -> dict:
     """Launch one kfrun gradient-pipeline job; rank 0's row."""
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -229,22 +325,23 @@ def run_grad_one(np_: int, model: str, steps: int, warmup: int,
         env["KF_BENCH_OUT"] = out_path
         env.setdefault("KF_LOG_LEVEL", "warn")
         env["JAX_PLATFORMS"] = "cpu"
-        cmd = [sys.executable, "-m", "kungfu_tpu.run",
-               "-np", str(np_), "-port-range", port_range,
-               "-logdir", os.path.join(td, "logs"), "-q", "--",
-               sys.executable, "-m", "kungfu_tpu.benchmarks.allreduce",
-               "--grad-worker", "--model", model,
-               "--steps", str(steps), "--warmup", str(warmup),
-               "--pipeline", pipeline, "--compress", compress,
-               "--backward-ms", str(backward_ms),
-               "--bucket-mb", str(bucket_mb)]
-        r = subprocess.run(cmd, env=env, cwd=repo, timeout=timeout,
-                           capture_output=True, text=True)
-        if r.returncode != 0 or not os.path.exists(out_path):
+        env.update(extra_env or {})
+        worker = [sys.executable, "-m", "kungfu_tpu.benchmarks.allreduce",
+                  "--grad-worker", "--model", model,
+                  "--steps", str(steps), "--warmup", str(warmup),
+                  "--pipeline", pipeline, "--compress", compress,
+                  "--backward-ms", str(backward_ms),
+                  "--bucket-mb", str(bucket_mb)]
+        try:
+            _launch_cluster(worker, np_, port_range, td, env,
+                            hosts=hosts, strategy=strategy,
+                            timeout=timeout)
+        except RuntimeError as e:
             raise RuntimeError(
-                f"grad np={np_} {pipeline}/{compress} failed "
-                f"rc={r.returncode}:"
-                f"\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+                f"grad np={np_} {pipeline}/{compress}: {e}") from e
+        if not os.path.exists(out_path):
+            raise RuntimeError(
+                f"grad np={np_} {pipeline}/{compress}: no rank-0 output")
         with open(out_path) as f:
             return json.load(f)
 
@@ -288,7 +385,8 @@ def grad_matrix_main(args) -> None:
 
 def run_one(np_: int, strategy: str, model: str, epochs: int,
             warmup: int, fuse: bool, port_range: str,
-            timeout: float = 300.0, mode: str = "seq") -> dict:
+            timeout: float = 300.0, mode: str = "seq", hosts: str = "",
+            extra_env: dict = None) -> dict:
     """Launch one kfrun job and return rank 0's measurement dict."""
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -301,24 +399,82 @@ def run_one(np_: int, strategy: str, model: str, epochs: int,
         # control-plane workers must not touch the (process-exclusive)
         # TPU: the catalog init alone would acquire it in every worker
         env["JAX_PLATFORMS"] = "cpu"
-        cmd = [sys.executable, "-m", "kungfu_tpu.run",
-               "-np", str(np_), "-strategy", strategy,
-               "-port-range", port_range,
-               "-logdir", os.path.join(td, "logs"), "-q", "--",
-               sys.executable, "-m", "kungfu_tpu.benchmarks.allreduce",
-               "--worker", "--model", model, "--epochs", str(epochs),
-               "--warmup", str(warmup), "--mode", mode] \
+        env.update(extra_env or {})
+        worker = [sys.executable, "-m", "kungfu_tpu.benchmarks.allreduce",
+                  "--worker", "--model", model, "--epochs", str(epochs),
+                  "--warmup", str(warmup), "--mode", mode] \
             + (["--fuse"] if fuse else [])
-        r = subprocess.run(cmd, env=env, cwd=repo, timeout=timeout,
-                           capture_output=True, text=True)
-        if r.returncode != 0 or not os.path.exists(out_path):
+        try:
+            _launch_cluster(worker, np_, port_range, td, env,
+                            hosts=hosts, strategy=strategy,
+                            timeout=timeout)
+        except RuntimeError as e:
             raise RuntimeError(
-                f"np={np_} strategy={strategy} failed rc={r.returncode}:"
-                f"\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+                f"np={np_} strategy={strategy}: {e}") from e
+        if not os.path.exists(out_path):
+            raise RuntimeError(
+                f"np={np_} strategy={strategy}: no rank-0 output")
         with open(out_path) as f:
             row = json.load(f)
     row["strategy"] = strategy
     return row
+
+
+def strategy_sweep_main(args) -> None:
+    """Head-to-head catalog sweep: np x every concrete strategy.
+
+    The reference's core differentiator (pluggable all-reduce graphs)
+    had never been benchmarked head-to-head in this repo; this
+    publishes the np in {2,3,4} x {STAR..MULTI_BINARY_TREE_STAR} rows
+    to BASELINE (``allreduce_strategy_catalog``) and, with --publish,
+    emits the round's BENCH_rNN.json so the run-all.sh round gate
+    stays green.
+    """
+    strategies = [s for s in STRATEGIES if s != "AUTO"]
+    rows = []
+    for np_ in [int(s) for s in args.np.split(",")]:
+        for strategy in strategies:
+            rows.append(run_one(np_, strategy, args.model, args.epochs,
+                                args.warmup, args.fuse, args.port_range,
+                                mode=args.mode))
+            print(json.dumps(rows[-1]), flush=True)
+    best_per_np = {}
+    for np_ in sorted({r["np"] for r in rows}):
+        best = max((r for r in rows if r["np"] == np_),
+                   key=lambda r: r["rate_gbps"])
+        best_per_np[f"np{np_}"] = {"strategy": best["strategy"],
+                                   "rate_gbps": best["rate_gbps"]}
+    result = {
+        "metric": "allreduce_strategy_catalog",
+        "model": args.model,
+        "mode": args.mode,
+        "note": ("loopback fabric, 1-core container: rates rank the "
+                 "strategies' hop structure, not real DCN bandwidth"),
+        "best_per_np": best_per_np,
+        "rows": [{k: r[k] for k in ("np", "strategy", "rate_gbps",
+                                    "seconds")} for r in rows],
+    }
+    print(json.dumps(result), flush=True)
+    if args.publish:
+        from .publish import publish_result
+
+        overall = max(rows, key=lambda r: r["rate_gbps"])
+        publish_result(
+            "allreduce_strategy_catalog", result,
+            parsed={
+                "metric": "allreduce_strategy_catalog_best_rate",
+                "value": overall["rate_gbps"],
+                "unit": "GB/s (ring-equivalent formula)",
+                "details": {
+                    "best": {k: overall[k]
+                             for k in ("np", "strategy", "rate_gbps")},
+                    "np": sorted({r["np"] for r in rows}),
+                    "strategies": strategies,
+                    "caveat": "1-core loopback; see BASELINE.md",
+                },
+            },
+            cmd=("python -m kungfu_tpu.benchmarks.allreduce "
+                 "--strategy-sweep --publish"))
 
 
 def main():
@@ -332,10 +488,19 @@ def main():
     ap.add_argument("--mode", default="seq", choices=("seq", "par"),
                     help="await tensors one-by-one (seq) or issue all "
                          "concurrently (par), like the reference")
-    ap.add_argument("--np", default="2,4",
-                    help="comma-separated worker counts (driver mode)")
+    ap.add_argument("--np", default=None,
+                    help="comma-separated worker counts (driver mode; "
+                         "default 2,4 — or 2,3,4 for --strategy-sweep)")
     ap.add_argument("--strategies", default="RING,BINARY_TREE_STAR,AUTO")
     ap.add_argument("--port-range", default="11000-12500")
+    # full-catalog head-to-head (docs/collectives.md): np x all seven
+    # concrete strategies, BASELINE + BENCH_rNN via --publish
+    ap.add_argument("--strategy-sweep", action="store_true",
+                    help="driver: sweep the whole strategy catalog "
+                         "head-to-head instead of --strategies")
+    ap.add_argument("--publish", action="store_true",
+                    help="with --strategy-sweep: merge into "
+                         "BASELINE.json + emit BENCH_rNN.json")
     # gradient-pipeline benchmark (docs/grad_pipeline.md):
     # {lump, bucketed} x {none, bf16, int8} with a simulated backward
     ap.add_argument("--grad-pipeline", action="store_true",
@@ -356,8 +521,16 @@ def main():
                          args.pipeline, args.compress, args.backward_ms,
                          args.bucket_mb)
         return
+    if args.np is None:
+        # the sweep's published axis is 2,3,4; everything else keeps
+        # the historical 2,4 (None lets an explicit --np 2,4 through
+        # to the sweep unchanged)
+        args.np = "2,3,4" if args.strategy_sweep else "2,4"
     if args.grad_pipeline:
         grad_matrix_main(args)
+        return
+    if args.strategy_sweep:
+        strategy_sweep_main(args)
         return
     if args.worker:
         worker_main(args.model, args.epochs, args.warmup, args.fuse,

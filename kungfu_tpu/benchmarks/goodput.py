@@ -199,20 +199,10 @@ def main(argv=None) -> int:
     line = json.dumps(result)
     print(line, flush=True)
     if args.publish:
-        from .publish import REPO, current_round, emit_bench
+        from .publish import publish_result
 
-        json_path = args.json or os.path.join(REPO, "BASELINE.json")
-        with open(json_path) as f:
-            baseline = json.load(f)
-        rnd = current_round()
-        result["round"] = rnd
-        baseline.setdefault("published", {})["goodput_under_churn"] \
-            = result
-        with open(json_path, "w") as f:
-            json.dump(baseline, f, indent=2)
-            f.write("\n")
-        bench_path = emit_bench(
-            rnd,
+        publish_result(
+            "goodput_under_churn", result,
             parsed={
                 "metric": "scenario_goodput_ratio_mean",
                 "value": result["mean_goodput_ratio"],
@@ -226,9 +216,7 @@ def main(argv=None) -> int:
                 },
             },
             cmd="python -m kungfu_tpu.benchmarks.goodput --publish",
-            tail=line)
-        print(f"published goodput_under_churn -> {json_path} and "
-              f"{bench_path}", flush=True)
+            json_path=args.json)
     return 0
 
 

@@ -77,6 +77,29 @@ def emit_bench(rnd: int, parsed: dict, cmd: str, tail: str,
     return path
 
 
+def publish_result(metric: str, result: dict, parsed: dict, cmd: str,
+                   json_path: str = "") -> str:
+    """Merge one benchmark's `result` into BASELINE.json under
+    ``published[metric]`` (stamping the current round) and emit the
+    round's BENCH_rNN.json with `parsed` as the headline — the one
+    publish protocol, so the goodput/strategy/transport publishers
+    cannot drift from each other or from the round gate."""
+    json_path = json_path or os.path.join(REPO, "BASELINE.json")
+    with open(json_path) as f:
+        baseline = json.load(f)
+    rnd = current_round()
+    result["round"] = rnd
+    baseline.setdefault("published", {})[metric] = result
+    with open(json_path, "w") as f:
+        json.dump(baseline, f, indent=2)
+        f.write("\n")
+    bench_path = emit_bench(rnd, parsed=parsed, cmd=cmd,
+                            tail=json.dumps(result))
+    print(f"published {metric} -> {json_path} and {bench_path}",
+          flush=True)
+    return bench_path
+
+
 def check_round() -> int:
     """CI gate (scripts/run-all.sh stage 0): the current round's
     BENCH file must exist — a round that only updates BASELINE.json

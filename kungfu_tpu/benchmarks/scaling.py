@@ -32,6 +32,91 @@ import json
 from .throughput import MODELS, measure_rate
 
 
+def transport_matrix_main(args) -> int:
+    """np x {flat, hier} x {tcp, unix, shm} on the fp32 gradient lump.
+
+    The hierarchical-collectives acceptance matrix (ISSUE 13,
+    docs/collectives.md): np workers split over two simulated hosts
+    (127.0.0.1 + 127.0.0.2) run the per-step fp32 gradient all-reduce
+    as a post-backward lump under STAR, each cell pinning one wire
+    class for the colocated pairs and flat-vs-hierarchical graphs.
+    Publishes exposed comm, step wall, and the link-class egress split
+    — "socket egress drops, exposed comm shrinks" is the claim under
+    test. With --publish: BASELINE.json ``hier_collectives`` +
+    BENCH_rNN.json.
+    """
+    from .allreduce import TRANSPORT_ENV, run_grad_one, two_host_spec
+
+    sizes = [int(s) for s in (args.sizes or "2,4,8").split(",")]
+    rows = []
+    for np_ in sizes:
+        hosts = two_host_spec(np_)
+        for hier in ("flat", "hier"):
+            for transport in ("tcp", "unix", "shm"):
+                env = dict(TRANSPORT_ENV[transport])
+                env["KF_HIER"] = "1" if hier == "hier" else "0"
+                # STAR, not AUTO: AUTO already resolves to the host-
+                # aware binary-tree-star across hosts, which would make
+                # "flat" half-hierarchical and hide the A/B
+                r = run_grad_one(np_, args.dcn_model, args.iters,
+                                 args.warmup, "lump", "none",
+                                 args.backward_ms, args.bucket_mb,
+                                 args.port_range, hosts=hosts,
+                                 extra_env=env, strategy="STAR")
+                r["hosts"] = hosts
+                r["mode"] = hier
+                r["transport"] = transport
+                rows.append(r)
+                print(json.dumps(r), flush=True)
+    result = {
+        "metric": "hier_collectives",
+        "model": rows[0]["model"],
+        "backward_ms": args.backward_ms,
+        "strategy": "STAR",
+        "note": ("two simulated hosts on loopback, 1-core container: "
+                 "the byte attribution (socket egress off the kernel "
+                 "stack) is the portable result; wall deltas rank the "
+                 "per-hop overhead, not real DCN bandwidth"),
+        "rows": [{k: r[k] for k in
+                  ("np", "mode", "transport", "hosts",
+                   "exposed_comm_ms", "step_ms",
+                   "egress_mb_per_step", "socket_egress_mb_per_step",
+                   "egress_by_link_mb_per_step")} for r in rows],
+    }
+    print(json.dumps(result), flush=True)
+    if args.publish:
+        from .publish import publish_result
+
+        by = {(r["np"], r["mode"], r["transport"]): r for r in rows}
+        mid = sorted(sizes)[len(sizes) // 2] if len(sizes) > 1 \
+            else sizes[0]
+        flat = by[(mid, "flat", "tcp")]
+        hier = by[(mid, "hier", "shm")]
+        publish_result(
+            "hier_collectives", result,
+            parsed={
+                "metric": "hier_shm_exposed_comm_vs_flat_tcp",
+                "value": round(hier["exposed_comm_ms"]
+                               / max(1e-9, flat["exposed_comm_ms"]),
+                               3),
+                "unit": (f"np={mid} fp32-lump exposed-comm ratio "
+                         "(hier+shm / flat+tcp; <1 = faster)"),
+                "details": {
+                    "flat_tcp_exposed_ms": flat["exposed_comm_ms"],
+                    "hier_shm_exposed_ms": hier["exposed_comm_ms"],
+                    "flat_socket_egress_mb":
+                        flat["socket_egress_mb_per_step"],
+                    "hier_socket_egress_mb":
+                        hier["socket_egress_mb_per_step"],
+                    "np": sizes,
+                    "caveat": "1-core loopback; see BASELINE.md",
+                },
+            },
+            cmd=("python -m kungfu_tpu.benchmarks.scaling --dcn-grad "
+                 "--transport-matrix --publish"))
+    return 0
+
+
 def dcn_grad_main(args) -> int:
     """DCN gradient-step scaling: efficiency = backward / step wall."""
     from .allreduce import run_grad_one
@@ -88,8 +173,17 @@ def main(argv=None) -> int:
     ap.add_argument("--backward-ms", type=float, default=150.0)
     ap.add_argument("--bucket-mb", type=float, default=1.0)
     ap.add_argument("--port-range", default="14000-15500")
+    ap.add_argument("--transport-matrix", action="store_true",
+                    help="with --dcn-grad: np x {flat,hier} x "
+                         "{tcp,unix,shm} over two simulated hosts "
+                         "(docs/collectives.md)")
+    ap.add_argument("--publish", action="store_true",
+                    help="with --transport-matrix: merge into "
+                         "BASELINE.json + emit BENCH_rNN.json")
     args = ap.parse_args(argv)
 
+    if args.dcn_grad and args.transport_matrix:
+        return transport_matrix_main(args)
     if args.dcn_grad:
         return dcn_grad_main(args)
 

@@ -230,4 +230,8 @@ def stream_broadcast(peer, tree, root: int = 0,
     phases["wall_ms"] = wall * 1e3
     phases["overlap_ms"] = max(
         0.0, (t_pack + t_bcast[0] - wall) * 1e3)
+    # link-class attribution ({tcp, unix, shm}, docs/collectives.md)
+    publish = getattr(peer, "publish_link_metrics", None)
+    if publish is not None:
+        publish()
     return jax.tree_util.tree_unflatten(treedef, out), phases

@@ -45,6 +45,16 @@ CONFIG_VARS = (
     "KF_STREAM_CHUNK_MB",
     "KF_GRAD_BUCKET_MB",
     "KF_GRAD_COMPRESS",
+    # wire transport + topology (docs/collectives.md): KF_SHM=0 opts
+    # colocated peers out of the shared-memory rings, KF_HIER=1 turns
+    # every strategy into its hierarchical (intra-host -> masters ->
+    # intra-host) decomposition, KF_NO_UNIX_SOCKET=1 disables the
+    # AF_UNIX fallback (the tcp-vs-unix A/B axis — it was read by the
+    # native transport from day one but never forwarded by the
+    # launcher, so the A/B could not be driven through kfrun)
+    "KF_SHM",
+    "KF_HIER",
+    "KF_NO_UNIX_SOCKET",
     # durable sharded checkpoints (docs/fault_tolerance.md): the
     # last rung of the recovery state machine
     "KF_CKPT_DIR",
@@ -96,6 +106,23 @@ def env_float(name: str, default: float,
     return v
 
 
+def env_flag(name: str, default: bool = False,
+             environ: Optional[Dict[str, str]] = None) -> bool:
+    """Parse a boolean KF_* variable: only "0", "1" (and unset/empty ->
+    `default`) are accepted, so ``KF_SHM=yes`` fails loudly at worker
+    bootstrap instead of silently meaning whatever getenv-truthiness
+    the native side happens to use."""
+    e = os.environ if environ is None else environ
+    raw = e.get(name, "")
+    if raw == "":
+        return default
+    if raw not in ("0", "1"):
+        raise ValueError(
+            f"{name}={raw!r} must be 0 or 1; unset it for the default "
+            f"({int(default)})")
+    return raw == "1"
+
+
 def env_choice(name: str, default: str, choices,
                environ: Optional[Dict[str, str]] = None) -> str:
     """Parse an enum-valued KF_* variable with a clear error naming the
@@ -141,6 +168,13 @@ def from_env(environ: Optional[Dict[str, str]] = None) -> Config:
     (the reference's single-process fallback, env/config.go:24-76).
     """
     e = os.environ if environ is None else environ
+    # transport/topology flags are consumed by the native library via
+    # getenv; validate them here so a typo fails at worker bootstrap
+    # with a named error instead of a silently-flat (or silently
+    # socket-bound) cluster
+    env_flag("KF_SHM", True, e)
+    env_flag("KF_HIER", False, e)
+    env_flag("KF_NO_UNIX_SOCKET", False, e)
     self_spec = e.get(SELF_SPEC, "")
     if not self_spec:
         solo = PeerID.from_host("127.0.0.1", 0)

@@ -18,6 +18,8 @@ from typing import Optional
 
 import numpy as np
 
+from .plan import topology as _topology
+
 _LIB_DIR = os.path.join(os.path.dirname(__file__), "native")
 _LIB_PATH = os.environ.get("KF_LIB", os.path.join(_LIB_DIR, "libkf.so"))
 
@@ -39,17 +41,16 @@ _ERR_NAMES = {
     KF_ERR_ARG: "invalid argument",
 }
 
-# strategy codes
-STRATEGIES = {
-    "STAR": 0,
-    "RING": 1,
-    "CLIQUE": 2,
-    "TREE": 3,
-    "BINARY_TREE": 4,
-    "BINARY_TREE_STAR": 5,
-    "MULTI_BINARY_TREE_STAR": 6,
-    "AUTO": 7,
-}
+# strategy codes: plan.topology.STRATEGY_NAMES is the one catalog
+# (docs/collectives.md); the native enum (include/kf.h) follows the
+# same order, with AUTO one past the concrete shapes
+STRATEGIES = {name: code
+              for code, name in enumerate(_topology.STRATEGY_NAMES)}
+STRATEGIES["AUTO"] = len(_topology.STRATEGY_NAMES)
+
+#: wire link classes, in kf_link_stats order (docs/collectives.md):
+#: TCP socket, AF_UNIX socket, shared-memory ring
+LINK_CLASSES = ("tcp", "unix", "shm")
 
 _NP_DTYPE_CODES = {
     np.dtype(np.uint8): 0,
@@ -153,6 +154,8 @@ def _bind_lib() -> ctypes.CDLL:
         "kf_ping": ([P, ctypes.c_int, ctypes.POINTER(i64)], ctypes.c_int),
         "kf_stats": ([P, ctypes.POINTER(ctypes.c_uint64),
                       ctypes.POINTER(ctypes.c_uint64)], None),
+        "kf_link_stats": ([P, ctypes.POINTER(ctypes.c_uint64)], None),
+        "kf_hier": ([P], ctypes.c_int),
         "kf_version_string": ([], cs),
         "kf_accumulate": ([P, P, i64, ctypes.c_int, ctypes.c_int,
                            ctypes.c_int], ctypes.c_int),
@@ -638,3 +641,27 @@ class NativePeer:
         ing = ctypes.c_uint64(0)
         self._lib.kf_stats(self._h, ctypes.byref(eg), ctypes.byref(ing))
         return {"egress_bytes": eg.value, "ingress_bytes": ing.value}
+
+    def link_stats(self):
+        """Cumulative payload bytes per wire link class.
+
+        ``{"egress": {"tcp":..,"unix":..,"shm":..}, "ingress": {...}}``
+        — the attribution behind kf_wire_bytes_total{link=...}
+        (docs/collectives.md). The ``stats()`` totals are always the
+        sum of the classes, so "socket egress" = tcp + unix.
+        """
+        arr = (ctypes.c_uint64 * 6)()
+        self._lib.kf_link_stats(self._h, arr)
+        return {
+            "egress": dict(zip(LINK_CLASSES, arr[0:3])),
+            "ingress": dict(zip(LINK_CLASSES, arr[3:6])),
+        }
+
+    @property
+    def hierarchical(self) -> bool:
+        """True when the live session walks KF_HIER=1 hierarchical
+        graphs (intra-host -> host masters -> intra-host), re-derived
+        from the peer list at every epoch switch. False when there is
+        no live session (kf_hier then returns a negative error code,
+        which must not truthy-convert to "hierarchical")."""
+        return self._lib.kf_hier(self._h) == 1

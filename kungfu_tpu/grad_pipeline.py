@@ -380,6 +380,11 @@ class GradBucketPipeline:
                              collective="grad")
         metrics.REGISTRY.set("kf_grad_arrival_lag_ms",
                              max(0.0, (wall - t_wire[0]) * 1e3))
+        # link-class attribution of the same family ({tcp, unix, shm},
+        # docs/collectives.md) from the native per-link counters
+        publish = getattr(self.peer, "publish_link_metrics", None)
+        if publish is not None:
+            publish()
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
     # -- wire slots (run on the OrderGroup executor, schedule order) ---------

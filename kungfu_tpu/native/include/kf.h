@@ -156,6 +156,16 @@ void kf_order_group_free(kf_order_group *);
 
 int kf_ping(kf_peer *, int rank, int64_t *rtt_us); /* RTT to peer */
 void kf_stats(kf_peer *, uint64_t *egress_bytes, uint64_t *ingress_bytes);
+/* Cumulative payload bytes per wire link class, for the link-class
+ * byte attribution of kf_wire_bytes_total{link=...}: out[0..2] =
+ * egress over {tcp, unix, shm}, out[3..5] = ingress over the same.
+ * The kf_stats totals are always the sum of the classes. */
+void kf_link_stats(kf_peer *, uint64_t out[6]);
+/* 1 when the current session walks hierarchical (KF_HIER=1) graphs:
+ * intra-host reduce -> inter-host strategy over host masters ->
+ * intra-host broadcast, re-derived from the peer list on every epoch
+ * switch. 0 = flat strategy graphs. */
+int kf_hier(kf_peer *);
 
 /* --- reduce kernels ------------------------------------------------------ */
 

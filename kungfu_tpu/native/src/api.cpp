@@ -208,6 +208,19 @@ void kf_stats(kf_peer *p, uint64_t *egress_bytes, uint64_t *ingress_bytes) {
     if (ingress_bytes) *ingress_bytes = p->impl.counters.ingress.load();
 }
 
+void kf_link_stats(kf_peer *p, uint64_t out[6]) {
+    if (!p || !out) return;
+    for (int i = 0; i < kNumLinkClasses; i++) {
+        out[i] = p->impl.counters.egress_link[i].load();
+        out[kNumLinkClasses + i] = p->impl.counters.ingress_link[i].load();
+    }
+}
+
+int kf_hier(kf_peer *p) {
+    return with_session(
+        p, [](Session *s) { return s->hierarchical() ? 1 : 0; });
+}
+
 kf_order_group *kf_order_group_new(int n, const int *exec_order) {
     if (n < 0) return nullptr;
     std::vector<int> order;

@@ -385,6 +385,95 @@ GraphPair rooted_pair(Strategy s, const std::vector<PeerID> &peers, int root,
     return {reduce_graph_of(bcast), bcast};
 }
 
+namespace {
+
+// Copy a master-level graph into the full rank space via masters[i] ->
+// global rank, preserving edge order (float accumulation order is part
+// of the cross-rank contract).
+void embed_masters(const Graph &g, const std::vector<int> &masters,
+                   Graph *out) {
+    for (int i = 0; i < g.n; i++) {
+        if (g.self_loop[size_t(i)]) out->add_edge(masters[i], masters[i]);
+        for (int j : g.next[i]) out->add_edge(masters[i], masters[j]);
+    }
+}
+
+// Compose one master-level (reduce, bcast) pair with the intra-host
+// star stages: leaves reduce into their host master, masters run the
+// embedded inter-host pair, masters broadcast back to their leaves.
+GraphPair compose_hier_pair(const GraphPair &mp, int n,
+                            const std::vector<int> &masters,
+                            const std::unordered_map<uint32_t, int>
+                                &host_master,
+                            const std::vector<PeerID> &peers) {
+    Graph rg(n), bg(n);
+    embed_masters(mp.first, masters, &rg);
+    embed_masters(mp.second, masters, &bg);
+    for (int r = 0; r < n; r++) {
+        const int m = host_master.at(peers[size_t(r)].ipv4);
+        if (m == r) continue;
+        rg.add_edge(r, m);  // intra-host reduce: leaf -> its master
+        bg.add_edge(m, r);  // intra-host bcast: master -> its leaves
+    }
+    return {rg, bg};
+}
+
+}  // namespace
+
+bool hier_enabled() {
+    const char *e = std::getenv("KF_HIER");
+    return e && std::strcmp(e, "1") == 0;
+}
+
+std::vector<GraphPair> build_hierarchical(Strategy s,
+                                          const std::vector<PeerID> &peers) {
+    const int n = int(peers.size());
+    std::vector<int> masters;
+    std::unordered_map<uint32_t, int> host_master;
+    local_masters(peers, &masters, &host_master);
+    if (int(masters.size()) == n) return build_strategy(s, peers);
+    std::vector<PeerID> mpeers;
+    mpeers.reserve(masters.size());
+    for (int m : masters) mpeers.push_back(peers[size_t(m)]);
+    // the inter-host stage IS the configured strategy, over the masters
+    // (AUTO re-resolves against the master list inside build_strategy)
+    auto mpairs = build_strategy(s, mpeers);
+    std::vector<GraphPair> out;
+    out.reserve(mpairs.size());
+    for (auto &mp : mpairs)
+        out.push_back(
+            compose_hier_pair(mp, n, masters, host_master, peers));
+    return out;
+}
+
+int hier_rooted_variants(Strategy s, const std::vector<PeerID> &peers,
+                         int root) {
+    std::vector<int> masters;
+    std::unordered_map<uint32_t, int> host_master;
+    rooted_masters(peers, root, &masters, &host_master);
+    if (int(masters.size()) == int(peers.size()))
+        return rooted_variants(s, peers);
+    std::vector<PeerID> mpeers;
+    for (int m : masters) mpeers.push_back(peers[size_t(m)]);
+    return rooted_variants(s, mpeers);
+}
+
+GraphPair hier_rooted_pair(Strategy s, const std::vector<PeerID> &peers,
+                           int root, int variant) {
+    const int n = int(peers.size());
+    std::vector<int> masters;
+    std::unordered_map<uint32_t, int> host_master;
+    rooted_masters(peers, root, &masters, &host_master);
+    if (int(masters.size()) == n) return rooted_pair(s, peers, root, variant);
+    std::vector<PeerID> mpeers;
+    mpeers.reserve(masters.size());
+    for (int m : masters) mpeers.push_back(peers[size_t(m)]);
+    // masters[0] == root (rooted_masters forces root to master its own
+    // host), so the master-level pair is rooted at master index 0
+    const GraphPair mp = rooted_pair(s, mpeers, 0, variant);
+    return compose_hier_pair(mp, n, masters, host_master, peers);
+}
+
 std::vector<GraphPair> build_strategy(Strategy s,
                                       const std::vector<PeerID> &peers) {
     const int k = int(peers.size());

@@ -138,4 +138,27 @@ GraphPair rooted_pair(Strategy s, const std::vector<PeerID> &peers, int root,
 Graph star_graph(int k, int r);
 Graph reduce_graph_of(const Graph &bcast);
 
+// ------------------------------------------------- hierarchical composition
+// KF_HIER=1 (docs/collectives.md): every strategy S becomes hier(S) —
+// an intra-host reduce to each host master (leaves -> master, over the
+// shm rings when colocated), the *existing* strategy graphs of S
+// restricted to the masters for the inter-host stage, then an
+// intra-host broadcast (Horovod hierarchical allreduce / BlueConnect
+// topology decomposition). Composed as ordinary (reduce, bcast) graph
+// pairs in the full rank space, so Session::run_graphs walks them
+// unchanged and every byte of the protocol (chunking, rendezvous
+// names, epoch fencing) is identical to the flat path.
+// With no colocation (every rank its own host) hier(S) == S exactly.
+std::vector<GraphPair> build_hierarchical(Strategy s,
+                                          const std::vector<PeerID> &peers);
+// Rooted variants of hier(S): the master-level interior rotates for
+// chunk spreading exactly like the flat rooted pairs.
+int hier_rooted_variants(Strategy s, const std::vector<PeerID> &peers,
+                         int root);
+GraphPair hier_rooted_pair(Strategy s, const std::vector<PeerID> &peers,
+                           int root, int variant);
+// KF_HIER=1 at Session construction (re-read per construction so every
+// epoch switch / recovery re-plans from the live environment+PeerList).
+bool hier_enabled();
+
 }  // namespace kf

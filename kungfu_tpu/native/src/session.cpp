@@ -43,7 +43,12 @@ Session::Session(PeerID self, std::vector<PeerID> peers, Strategy strategy,
         }
     }
     strategy_ = resolve_auto(strategy, peers_);
-    strategies_ = build_strategy(strategy_, peers_);
+    // hierarchy is re-derived from the PeerList here on EVERY session
+    // construction — i.e. on every epoch switch and recovery — so a
+    // grow/shrink re-plans the whole intra/inter-host decomposition
+    hier_ = hier_enabled();
+    strategies_ = hier_ ? build_hierarchical(strategy_, peers_)
+                        : build_strategy(strategy_, peers_);
 }
 
 std::shared_ptr<const std::vector<GraphPair>> Session::rooted_pairs(
@@ -53,11 +58,14 @@ std::shared_ptr<const std::vector<GraphPair>> Session::rooted_pairs(
         auto it = rooted_cache_.find(root);
         if (it != rooted_cache_.end()) return it->second;
     }
-    const int nv = rooted_variants(strategy_, peers_);
+    const int nv = hier_ ? hier_rooted_variants(strategy_, peers_, root)
+                         : rooted_variants(strategy_, peers_);
     auto pairs = std::make_shared<std::vector<GraphPair>>();
     pairs->reserve(size_t(nv));
     for (int v = 0; v < nv; v++)
-        pairs->push_back(rooted_pair(strategy_, peers_, root, v));
+        pairs->push_back(hier_
+                             ? hier_rooted_pair(strategy_, peers_, root, v)
+                             : rooted_pair(strategy_, peers_, root, v));
     std::lock_guard<std::mutex> lk(rooted_mu_);
     auto &entry = rooted_cache_[root];
     if (!entry) entry = std::move(pairs);

@@ -25,6 +25,11 @@ class Session {
     int local_size() const { return local_size_; }
     const std::vector<PeerID> &peers() const { return peers_; }
 
+    // KF_HIER=1 at construction: collectives walk hier(strategy)
+    // graphs (intra-host -> masters -> intra-host; docs/collectives.md)
+    bool hierarchical() const { return hier_; }
+    Strategy strategy() const { return strategy_; }
+
     int all_reduce(const void *send, void *recv, int64_t count, Dtype dt,
                    ROp op, const std::string &name);
     int reduce(const void *send, void *recv, int64_t count, Dtype dt, ROp op,
@@ -63,6 +68,7 @@ class Session {
     std::vector<PeerID> peers_;
     int rank_ = -1, local_rank_ = 0, local_size_ = 1;
     Strategy strategy_ = Strategy::star;  // post-AUTO-resolution
+    bool hier_ = false;  // KF_HIER snapshot: graphs are hier(strategy_)
     std::vector<GraphPair> strategies_;
     std::mutex rooted_mu_;
     std::unordered_map<int, std::shared_ptr<const std::vector<GraphPair>>>

@@ -169,7 +169,95 @@ int main() {
     }
     if (failures) return 1;
 
+    // the 127.0.0.1 cluster above ran its whole collective load over
+    // the shm rings (colocated peers, KF_SHM default-on): assert the
+    // bytes actually moved off the socket stack
+    {
+        const uint64_t shm_eg =
+            ps[0]->counters.egress_link[int(LinkClass::shm)].load();
+        const uint64_t total = ps[0]->counters.egress.load();
+        if (shm_transport_enabled() && shm_eg == 0) {
+            std::fprintf(stderr, "no shm egress on a colocated cluster\n");
+            return 1;
+        }
+        uint64_t sum = 0;
+        for (int i = 0; i < kNumLinkClasses; i++)
+            sum += ps[0]->counters.egress_link[i].load();
+        if (sum != total) {
+            std::fprintf(stderr, "link-class egress %llu != total %llu\n",
+                         (unsigned long long)sum,
+                         (unsigned long long)total);
+            return 1;
+        }
+    }
+
     for (auto &p : ps) p->stop();
+
+    // hierarchical round: 2 simulated hosts (127.0.0.1 + 127.0.0.2,
+    // both loopback) x 2 peers under KF_HIER=1 — intra-host stage over
+    // shm rings, inter-host ring over the masters; results must match
+    // the flat formula exactly (integer-valued floats: association-
+    // free), exercising the composed graphs under every sanitizer
+    ::setenv("KF_HIER", "1", 1);
+    std::vector<PeerID> hpeers;
+    for (int r = 0; r < NP; r++) {
+        PeerID p;
+        p.ipv4 = (127u << 24) | (r < NP / 2 ? 1u : 2u);
+        p.port = uint16_t(base_port() + 8 + r);
+        hpeers.push_back(p);
+    }
+    std::vector<std::unique_ptr<Peer>> hs;
+    for (int r = 0; r < NP; r++)
+        hs.push_back(std::make_unique<Peer>(hpeers[r], hpeers, 0,
+                                            Strategy::ring, 20000));
+    for (auto &p : hs)
+        if (p->start() != 0) {
+            std::fprintf(stderr, "hier start failed\n");
+            return 1;
+        }
+    {
+        std::vector<std::thread> ts;
+        for (int r = 0; r < NP; r++)
+            ts.emplace_back([&, r] {
+                std::vector<float> b(2053, float(r + 1)), o(2053);
+                std::shared_lock<std::shared_mutex> lk(hs[r]->session_mu());
+                if (!hs[r]->session()->hierarchical()) {
+                    std::fprintf(stderr, "rank %d: session not hier\n", r);
+                    failures++;
+                    return;
+                }
+                int rc = hs[r]->session()->all_reduce(
+                    b.data(), o.data(), int64_t(b.size()), Dtype::f32,
+                    ROp::sum, "hier:ar");
+                if (rc != 0 || o[2052] != float(NP * (NP + 1) / 2)) {
+                    std::fprintf(stderr, "hier rank %d rc=%d out=%f\n", r,
+                                 rc, double(o[2052]));
+                    failures++;
+                    return;
+                }
+                // rooted collective over the hier graphs too
+                std::vector<int64_t> bc(17, r == 3 ? 42 : 0);
+                rc = hs[r]->session()->broadcast(bc.data(), bc.data(),
+                                                 17, Dtype::i64, 3,
+                                                 "hier:bc");
+                if (rc != 0 || bc[16] != 42) {
+                    std::fprintf(stderr, "hier bcast rank %d rc=%d\n", r,
+                                 rc);
+                    failures++;
+                }
+            });
+        for (auto &t : ts) t.join();
+    }
+    ::unsetenv("KF_HIER");
+    if (failures) return 1;
+    if (shm_transport_enabled() &&
+        hs[1]->counters.egress_link[int(LinkClass::shm)].load() == 0) {
+        // rank 1 is a leaf: its reduce contribution goes to its
+        // colocated master and must ride the ring
+        std::fprintf(stderr, "hier leaf sent no shm bytes\n");
+        return 1;
+    }
+    for (auto &p : hs) p->stop();
     std::printf("smoke ok\n");
     return 0;
 }

@@ -48,7 +48,11 @@ int64_t now_us() {
 }
 
 bool unix_sockets_disabled() {
-    return std::getenv("KF_NO_UNIX_SOCKET") != nullptr;
+    // "0" (and empty) mean enabled: the launcher forwards the variable
+    // verbatim through env.CONFIG_VARS, so KF_NO_UNIX_SOCKET=0 must be
+    // a usable "explicitly on" spelling, not a surprise disable
+    const char *e = std::getenv("KF_NO_UNIX_SOCKET");
+    return e != nullptr && *e != '\0' && std::strcmp(e, "0") != 0;
 }
 
 int ceil_log2(size_t n) {
@@ -64,6 +68,27 @@ void grow_unix_bufs(int fd) {
     int sz = 4 << 20;
     ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
     ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+}
+
+// Per-pair ring capacity: holds a few of the session's ~1MiB chunks;
+// bigger messages stream through in pieces as the reader drains.
+constexpr uint32_t kShmRingBytes = 4u << 20;
+
+// After the hello exchange the shm socket is silent, so any readability
+// (EOF, reset) means the sender is gone or fenced out.
+bool shm_sock_dead(int fd) {
+    pollfd p{fd, POLLIN, 0};
+    const int pr = ::poll(&p, 1, 0);
+    if (pr <= 0) return false;
+    if (p.revents & (POLLERR | POLLHUP | POLLNVAL)) return true;
+    if (p.revents & POLLIN) {
+        char b;
+        const ssize_t r = ::recv(fd, &b, 1, MSG_PEEK | MSG_DONTWAIT);
+        return r == 0 ||
+               (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                errno != EINTR);
+    }
+    return false;
 }
 
 }  // namespace
@@ -492,11 +517,19 @@ Client::~Client() {
         kv.second->fd = -1;
     }
     conns_.clear();
+    for (auto &kv : shm_) {
+        kv.second->abort.store(true);
+        std::lock_guard<std::mutex> clk(kv.second->mu);
+        if (kv.second->fd >= 0) ::close(kv.second->fd);
+        kv.second->fd = -1;
+        kv.second->ring.reset();
+    }
+    shm_.clear();
 }
 
 void Client::set_token(uint32_t token) { token_ = token; }
 
-int Client::dial_fd(const PeerID &dest) {
+int Client::dial_fd(const PeerID &dest, LinkClass *link) {
     // colocated peers (same IPv4) talk over a Unix socket, skipping the TCP
     // stack (reference: connection.go:60-64 dials SockFile when src/dst
     // share an IP); fall back to TCP if the socket file isn't there yet
@@ -509,6 +542,7 @@ int Client::dial_fd(const PeerID &dest) {
             std::strncpy(ua.sun_path, path.c_str(), sizeof(ua.sun_path) - 1);
             if (::connect(fd, (sockaddr *)&ua, sizeof(ua)) == 0) {
                 grow_unix_bufs(fd);
+                if (link) *link = LinkClass::uds;
                 return fd;
             }
             ::close(fd);
@@ -526,12 +560,13 @@ int Client::dial_fd(const PeerID &dest) {
         ::close(fd);
         return KF_ERR_CONN;
     }
+    if (link) *link = LinkClass::tcp;
     return fd;
 }
 
-int Client::dial(const PeerID &dest, ConnType t) {
+int Client::dial(const PeerID &dest, ConnType t, LinkClass *link) {
     TraceScope trace(Tracer::DIAL);
-    int fd = dial_fd(dest);
+    int fd = dial_fd(dest, link);
     if (fd < 0) return fd;
     ConnHeader h{uint16_t(t), self_.port, self_.ipv4, token_.load()};
     Ack ack{};
@@ -539,8 +574,10 @@ int Client::dial(const PeerID &dest, ConnType t) {
         ::close(fd);
         return KF_ERR_CONN;
     }
-    if (ack.token != token_.load() && t == ConnType::collective) {
-        // stale-epoch fence (reference: connection.go:81-87)
+    if (ack.token != token_.load() &&
+        (t == ConnType::collective || t == ConnType::shm)) {
+        // stale-epoch fence (reference: connection.go:81-87); shm
+        // channels carry collective traffic and fence identically
         ::close(fd);
         return KF_ERR_EPOCH;
     }
@@ -548,10 +585,17 @@ int Client::dial(const PeerID &dest, ConnType t) {
 }
 
 std::shared_ptr<Client::Conn> Client::get(const PeerID &dest, ConnType t) {
-    const uint64_t key = (dest.key() << 2) | uint64_t(t);
+    const uint64_t key = (dest.key() << 3) | uint64_t(t);
     std::lock_guard<std::mutex> lk(mu_);
     auto &c = conns_[key];
     if (!c) c = std::make_shared<Conn>();
+    return c;
+}
+
+std::shared_ptr<Client::ShmChan> Client::get_shm(const PeerID &dest) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto &c = shm_[dest.key()];
+    if (!c) c = std::make_shared<ShmChan>();
     return c;
 }
 
@@ -567,7 +611,7 @@ int Client::ensure_connected(Conn *c, const PeerID &dest, ConnType t) {
     const int budget = c->was_connected ? reconnect_retries
                                         : connect_retries;
     for (int i = 0; i <= budget; i++) {
-        last = dial(dest, t);
+        last = dial(dest, t, &c->link);
         if (last >= 0) break;
         // KF_ERR_EPOCH gets a short retry budget of its own: during a
         // resize, peers switch to the new cluster version at slightly
@@ -592,6 +636,14 @@ int Client::ensure_connected(Conn *c, const PeerID &dest, ConnType t) {
 int Client::send(const PeerID &dest, ConnType t, const std::string &name,
                  uint32_t flags, const void *data, size_t len) {
     TraceScope trace(Tracer::SEND);
+    // colocated collective traffic prefers the shared-memory ring (the
+    // same colocated_with check that picks the Unix socket); anything
+    // short of an established channel falls through to the sockets
+    if (t == ConnType::collective && shm_enabled_ &&
+        dest.colocated_with(self_) && !(dest == self_)) {
+        int rc = send_shm(dest, name, flags, data, len);
+        if (rc != kShmFallback) return rc;
+    }
     auto c = get(dest, t);
     std::lock_guard<std::mutex> lk(c->mu);
     // a pooled fd may have been kicked by the peer's epoch switch: one
@@ -600,13 +652,112 @@ int Client::send(const PeerID &dest, ConnType t, const std::string &name,
         int rc = ensure_connected(c.get(), dest, t);
         if (rc != KF_OK) return rc;
         if (write_message(c->fd, name, flags, data, len)) {
-            counters_->egress += len;
+            counters_->add_egress(c->link, len);
             return KF_OK;
         }
         ::close(c->fd);
         c->fd = -1;
     }
     return KF_ERR_CONN;
+}
+
+int Client::send_shm(const PeerID &dest, const std::string &name,
+                     uint32_t flags, const void *data, size_t len) {
+    auto ch = get_shm(dest);
+    std::lock_guard<std::mutex> lk(ch->mu);
+    if (ch->failed) return kShmFallback;
+    // the hello socket is the receiver's liveness/epoch signal: its
+    // EOF means the ring reader is gone (peer died, or its epoch
+    // switch kicked us), so writing would "succeed" into a ring
+    // nobody drains. Tear down and re-establish — the fresh dial
+    // re-runs the token handshake, so a stale-epoch sender fails
+    // with KF_ERR_EPOCH exactly like a kicked socket sender.
+    if (ch->ring && shm_sock_dead(ch->fd)) {
+        ::close(ch->fd);
+        ch->fd = -1;
+        ch->ring.reset();
+    }
+    if (!ch->ring) {
+        const std::string dir = shm_dir();
+        if (dir.empty()) {
+            ch->failed = true;
+            return kShmFallback;
+        }
+        // dial with the same patience budgets sockets get: full
+        // patience for a dest that may still be booting, the short
+        // reconnect budget once this channel was established and lost
+        // (a reached-then-lost peer died mid-epoch — senders must fail
+        // fast like receivers, not burn 30s re-dialing a corpse and
+        // then 30s more on a socket fallback), and the same
+        // stale-epoch fencing either way
+        int fd = KF_ERR_CONN;
+        int epoch_misses = 0;
+        const int budget = ch->was_connected ? reconnect_retries
+                                             : connect_retries;
+        for (int i = 0; i <= budget; i++) {
+            if (ch->abort.load()) return KF_ERR_CONN;  // epoch teardown
+            fd = dial(dest, ConnType::shm);
+            if (fd >= 0) break;
+            if (fd == KF_ERR_EPOCH && ++epoch_misses > epoch_retries)
+                return fd;  // genuinely stale: fail like a collective
+            std::this_thread::sleep_for(
+                std::chrono::milliseconds(connect_retry_ms));
+        }
+        if (fd < 0) {
+            if (fd == KF_ERR_EPOCH) return fd;
+            if (ch->was_connected) return KF_ERR_CONN;  // died mid-epoch
+            ch->failed = true;
+            return kShmFallback;
+        }
+        char path[192];
+        std::snprintf(path, sizeof(path), "%s/%08x-%u-%08x-%u-%u-%u.ring",
+                      dir.c_str(), self_.ipv4, unsigned(self_.port),
+                      dest.ipv4, unsigned(dest.port), unsigned(::getpid()),
+                      unsigned(shm_seq_.fetch_add(1)));
+        auto ring = ShmRing::create(path, kShmRingBytes);
+        // hello: the ring path travels over the fenced socket; the one
+        // ack byte proves the receiver mapped it (a receiver that
+        // cannot — /dev/shm full, policy — closes instead, and we keep
+        // the socket path with per-pair total message order intact)
+        uint8_t ack = 0;
+        if (!ring || !write_message(fd, path, 0, nullptr, 0) ||
+            !read_exact(fd, &ack, 1) || ack != 1) {
+            ::close(fd);
+            if (ring) ring->unlink();
+            ch->failed = true;
+            return kShmFallback;
+        }
+        ch->fd = fd;
+        ch->abort.store(false);
+        ch->ring = std::move(ring);
+        ch->was_connected = true;
+    }
+    // framed exactly like write_message, streamed into the ring; the
+    // payload goes source buffer -> ring with no staging vector
+    uint8_t hdr[12 + 4096];
+    const uint32_t name_len = uint32_t(name.size());
+    if (name_len > 4096) return KF_ERR_ARG;
+    std::memcpy(hdr, &name_len, 4);
+    std::memcpy(hdr + 4, name.data(), name_len);
+    const uint32_t len32 = uint32_t(len);
+    std::memcpy(hdr + 4 + name_len, &flags, 4);
+    std::memcpy(hdr + 8 + name_len, &len32, 4);
+    const int64_t stall = body_stall_ms();
+    auto alive = [&ch] { return !ch->abort.load(); };
+    if (!ch->ring->write(hdr, 12 + name_len, stall, alive) ||
+        (len && !ch->ring->write(data, len, stall, alive))) {
+        // receiver dead or torn down mid-epoch: fail like a lost
+        // collective conn (no silent socket fallback — per-pair order
+        // is law). `failed` stays false: a later send re-establishes
+        // under the short was_connected budget and fails fast again
+        // if the peer is really gone.
+        ::close(ch->fd);
+        ch->fd = -1;
+        ch->ring.reset();
+        return KF_ERR_CONN;
+    }
+    counters_->add_egress(LinkClass::shm, len);
+    return KF_OK;
 }
 
 int Client::request(const PeerID &dest, const std::string &version,
@@ -621,7 +772,7 @@ int Client::request(const PeerID &dest, const std::string &version,
         if (write_message(c->fd, name, 0, version.data(), version.size()) &&
             read_message(c->fd, &resp) && (resp.flags & kFlagIsResponse)) {
             if (resp.flags & kFlagRequestFailed) return KF_ERR_NOTFOUND;
-            counters_->ingress += resp.data.size();
+            counters_->add_ingress(c->link, resp.data.size());
             *out = std::move(resp.data);
             return KF_OK;
         }
@@ -654,8 +805,8 @@ void Client::reset(const std::vector<PeerID> &keep, uint32_t token) {
     for (auto &p : keep) keep_keys.insert(p.key());
     std::lock_guard<std::mutex> lk(mu_);
     for (auto it = conns_.begin(); it != conns_.end();) {
-        const uint64_t peer_key = it->first >> 2;
-        const auto t = ConnType(it->first & 3);
+        const uint64_t peer_key = it->first >> 3;
+        const auto t = ConnType(it->first & 7);
         // collective conns always reconnect under the new token; others
         // survive only if the peer remains a member
         const bool drop =
@@ -671,6 +822,20 @@ void Client::reset(const std::vector<PeerID> &keep, uint32_t token) {
             ++it;
         }
     }
+    // shm channels carry collective traffic: always rebuilt under the
+    // new token. `abort` first — a writer blocked on a full ring holds
+    // the channel mutex, and must be kicked out (it fails with
+    // KF_ERR_CONN, exactly like a socket sender whose fd got closed)
+    // before the teardown below can take that mutex.
+    for (auto &kv : shm_) kv.second->abort.store(true);
+    for (auto &kv : shm_) {
+        std::lock_guard<std::mutex> clk(kv.second->mu);
+        if (kv.second->fd >= 0) ::close(kv.second->fd);
+        kv.second->fd = -1;
+        if (kv.second->ring) kv.second->ring->close();
+        kv.second->ring.reset();
+    }
+    shm_.clear();
 }
 
 // ----------------------------------------------------------------- server
@@ -826,8 +991,9 @@ void Server::accept_loop(int listen_fd, bool tcp) {
         // detached: reaped via active_conns_ in stop(); the fd is removed
         // from live_fds_ BEFORE close so a recycled fd number can't be
         // erased by a stale cleanup
-        std::thread([this, fd] {
-            serve_conn(fd);
+        const LinkClass link = tcp ? LinkClass::tcp : LinkClass::uds;
+        std::thread([this, fd, link] {
+            serve_conn(fd, link);
             std::unique_lock<std::mutex> lk(mu_);
             live_fds_.erase(fd);
             ::close(fd);
@@ -838,13 +1004,17 @@ void Server::accept_loop(int listen_fd, bool tcp) {
 
 // NOTE: never closes fd — the accept_loop wrapper owns close, so the fd
 // number stays registered in live_fds_ until the instant it is released.
-void Server::serve_conn(int fd) {
+void Server::serve_conn(int fd, LinkClass link) {
     ConnHeader h;
     if (!read_exact(fd, &h, sizeof(h))) return;
     Ack ack{token_.load()};
     if (!write_exact(fd, &ack, sizeof(ack))) return;
     const PeerID src{h.src_ipv4, h.src_port};
     const auto t = ConnType(h.type);
+    if (t == ConnType::shm) {
+        serve_shm(fd, src, h.token == ack.token, ack.token);
+        return;
+    }
     if (t == ConnType::collective) {
         // a stale-epoch dial (mid-resize laggard) is not a liveness
         // signal either way: its EOF is the dialer noticing our ack's
@@ -865,7 +1035,7 @@ void Server::serve_conn(int fd) {
                 uint32_t flags, len;
                 if (!read_exact(fd, &flags, 4)) return;
                 if (!read_exact(fd, &len, 4)) return;
-                counters_->ingress += len;
+                counters_->add_ingress(link, len);
                 const int64_t stall = body_stall_ms();
                 if (auto *slot = rdv_->begin_recv(src, name, len)) {
                     const bool ok =
@@ -896,10 +1066,11 @@ void Server::serve_conn(int fd) {
     }
     WireMessage msg;
     while (running_ && read_message(fd, &msg)) {
-        counters_->ingress += msg.data.size();
+        counters_->add_ingress(link, msg.data.size());
         switch (t) {
             case ConnType::collective:
-                return;  // unreachable: dedicated loop above handles these
+            case ConnType::shm:
+                return;  // unreachable: dedicated loops above handle these
             case ConnType::p2p: {
                 RequestHandler handler;
                 {
@@ -917,7 +1088,7 @@ void Server::serve_conn(int fd) {
                 if (!write_message(fd, msg.name, flags, blob.data(),
                                    blob.size()))
                     return;
-                counters_->egress += blob.size();
+                counters_->add_egress(link, blob.size());
                 break;
             }
             case ConnType::control: {
@@ -937,6 +1108,61 @@ void Server::serve_conn(int fd) {
         }
         msg = WireMessage{};
     }
+}
+
+void Server::serve_shm(int fd, const PeerID &src, bool same_epoch,
+                       uint32_t epoch_token) {
+    // hello: exactly one message whose name is the sender's ring path
+    WireMessage hello;
+    if (!read_message(fd, &hello, 4096)) return;
+    auto ring = ShmRing::attach(hello.name);
+    uint8_t ok = ring ? 1 : 0;
+    if (ring) ring->unlink();  // both sides mapped: the name can go
+    if (!write_exact(fd, &ok, 1) || !ring) return;
+    if (same_epoch) rdv_->conn_opened(src);
+    // liveness mirrors the collective socket loop, but the data comes
+    // out of the ring: the silent hello socket supplies the death /
+    // epoch-reset signal (stop() and drop_connections() shut it down
+    // like any live fd), polled between messages and inside body waits
+    auto alive = [this, fd] { return running_ && !shm_sock_dead(fd); };
+    const int64_t stall = body_stall_ms();
+    while (running_) {
+        const int r = ring->wait_readable(100);
+        if (r < 0) break;  // producer closed (clean teardown)
+        if (r == 0) {
+            if (!alive()) break;
+            continue;
+        }
+        // a message has begun: the rest of its frame streams out under
+        // the same mid-body stall contract sockets get
+        uint32_t name_len;
+        if (!ring->read(&name_len, 4, stall, alive)) break;
+        if (name_len > 4096) break;
+        std::string name(name_len, '\0');
+        if (name_len && !ring->read(name.data(), name_len, stall, alive))
+            break;
+        uint32_t flags, len;
+        if (!ring->read(&flags, 4, stall, alive)) break;
+        if (!ring->read(&len, 4, stall, alive)) break;
+        counters_->add_ingress(LinkClass::shm, len);
+        if (auto *slot = rdv_->begin_recv(src, name, len)) {
+            // registered receive: ring bytes land straight in the
+            // caller's buffer — the zero-copy path end to end
+            const bool body_ok =
+                len == 0 || ring->read(slot->buf, len, stall, alive);
+            rdv_->commit_recv(slot, body_ok);
+            if (!body_ok) break;
+            continue;
+        }
+        WireMessage msg;
+        msg.name = std::move(name);
+        msg.flags = flags;
+        msg.data = BufferPool::instance().get(len);
+        if (len && !ring->read(msg.data.data(), len, stall, alive)) break;
+        rdv_->push(src, std::move(msg));
+    }
+    if (same_epoch)
+        rdv_->conn_lost(src, running_ && token_.load() == epoch_token);
 }
 
 }  // namespace kf

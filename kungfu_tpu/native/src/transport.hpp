@@ -25,6 +25,7 @@
 #include <vector>
 
 #include "core.hpp"
+#include "shm.hpp"
 
 namespace kf {
 
@@ -33,7 +34,18 @@ enum class ConnType : uint16_t {
     control = 1,
     collective = 2,
     p2p = 3,
+    // shm hello/liveness channel: the dial carries the normal epoch
+    // token handshake, then exactly one message naming the sender's
+    // ring segment; afterwards the socket is silent and its EOF is the
+    // (only) death/epoch-reset signal for the ring reader
+    shm = 4,
 };
+
+// Wire link classes for byte attribution (kf_link_stats; kftrace's
+// kf_wire_bytes_total{link=...} renders these): TCP socket, AF_UNIX
+// socket, shared-memory ring.
+enum class LinkClass : int { tcp = 0, uds = 1, shm = 2 };
+constexpr int kNumLinkClasses = 3;
 
 // message flags
 constexpr uint32_t kFlagIsResponse = 1u << 1;
@@ -188,6 +200,19 @@ class VersionedStore {
 
 struct Counters {
     std::atomic<uint64_t> egress{0}, ingress{0};
+    // per link class (LinkClass order: tcp, unix, shm) — the totals
+    // above stay the sum so existing consumers keep their meaning
+    std::atomic<uint64_t> egress_link[kNumLinkClasses]{{0}, {0}, {0}};
+    std::atomic<uint64_t> ingress_link[kNumLinkClasses]{{0}, {0}, {0}};
+
+    void add_egress(LinkClass lc, uint64_t n) {
+        egress += n;
+        egress_link[int(lc)] += n;
+    }
+    void add_ingress(LinkClass lc, uint64_t n) {
+        ingress += n;
+        ingress_link[int(lc)] += n;
+    }
 };
 
 // Connection pool: one persistent connection per (dest, type). Sends are
@@ -196,7 +221,8 @@ struct Counters {
 class Client {
   public:
     Client(PeerID self, Counters *counters)
-        : self_(self), counters_(counters) {}
+        : self_(self), counters_(counters),
+          shm_enabled_(shm_transport_enabled()) {}
     ~Client();
 
     void set_token(uint32_t token);
@@ -222,17 +248,42 @@ class Client {
         std::mutex mu;
         int fd = -1;
         bool was_connected = false;  // ever reached: lost => short retries
+        LinkClass link = LinkClass::tcp;  // what dial_fd chose
+    };
+    // One shm channel per colocated destination: the ring plus its
+    // hello/liveness socket. `abort` lets reset()/teardown unstick a
+    // writer blocked on a full ring WITHOUT taking `mu` (the writer
+    // holds it) — the shm analog of close(fd) kicking write_exact.
+    struct ShmChan {
+        std::mutex mu;
+        int fd = -1;
+        std::unique_ptr<ShmRing> ring;  // kf: guarded_by(mu)
+        bool failed = false;   // establishment failed: socket fallback
+        bool was_connected = false;  // ever established: lost => short
+                                     // re-dial budget, fail fast
+        std::atomic<bool> abort{false};
     };
     std::shared_ptr<Conn> get(const PeerID &dest, ConnType t);
-    int dial(const PeerID &dest, ConnType t);  // returns fd or negative err
-    int dial_fd(const PeerID &dest);           // raw connect, unix-or-tcp
+    std::shared_ptr<ShmChan> get_shm(const PeerID &dest);
+    int dial(const PeerID &dest, ConnType t,
+             LinkClass *link = nullptr);   // returns fd or negative err
+    int dial_fd(const PeerID &dest, LinkClass *link);  // raw connect
     int ensure_connected(Conn *c, const PeerID &dest, ConnType t);
+    // Collective send over the shm ring; returns kShmFallback when the
+    // channel cannot be (or was never) established — caller falls back
+    // to the socket path for the rest of the epoch.
+    static constexpr int kShmFallback = 1;
+    int send_shm(const PeerID &dest, const std::string &name,
+                 uint32_t flags, const void *data, size_t len);
 
     PeerID self_;
     Counters *counters_;
     std::mutex mu_;
     std::atomic<uint32_t> token_{0};
+    bool shm_enabled_ = false;  // snapshot of KF_SHM at construction
+    std::atomic<uint32_t> shm_seq_{0};  // unique ring paths per process
     std::unordered_map<uint64_t, std::shared_ptr<Conn>> conns_;
+    std::unordered_map<uint64_t, std::shared_ptr<ShmChan>> shm_;
 };
 
 // ----------------------------------------------------------------- server
@@ -264,7 +315,14 @@ class Server {
 
   private:
     void accept_loop(int listen_fd, bool tcp);
-    void serve_conn(int fd);
+    void serve_conn(int fd, LinkClass link);
+    // Ring-reader loop of one inbound shm channel: attach the segment
+    // named by the hello message, ack one byte, then parse framed
+    // messages out of the ring into the Rendezvous until the producer
+    // closes, the hello socket drops (sender death / epoch reset), or
+    // the server stops.
+    void serve_shm(int fd, const PeerID &src, bool same_epoch,
+                   uint32_t epoch_token);
 
     PeerID self_;
     Rendezvous *rdv_;

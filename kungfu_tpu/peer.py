@@ -16,6 +16,7 @@ import urllib.request
 from typing import Optional, Tuple
 
 from . import env as kfenv
+from . import ffi
 from . import retrying
 from .ffi import NativePeer
 from .plan import Cluster, PeerList
@@ -246,6 +247,37 @@ class Peer:
         if self._native is None:
             return {"egress_bytes": 0, "ingress_bytes": 0}
         return self._native.stats()
+
+    def link_stats(self):
+        """Cumulative payload bytes per wire link class
+        ({tcp, unix, shm}; docs/collectives.md)."""
+        if self._native is None:
+            zero = {c: 0 for c in ffi.LINK_CLASSES}
+            return {"egress": dict(zero), "ingress": dict(zero)}
+        return self._native.link_stats()
+
+    @property
+    def hierarchical(self) -> bool:
+        """True when collectives run the KF_HIER=1 hierarchical
+        decomposition (intra-host -> masters -> intra-host)."""
+        return (self._native is not None
+                and self._native.hierarchical)
+
+    def publish_link_metrics(self) -> None:
+        """Incrementally publish kf_wire_bytes_total{link=...} from
+        the native per-link-class egress counters. Called by the data
+        paths (gradient pipeline, streaming resync) after their wire
+        work so /metrics attributes traffic to {tcp, unix, shm}."""
+        from .trace import metrics
+
+        egress = self.link_stats()["egress"]
+        last = getattr(self, "_last_link_egress", {})
+        for cls, total in egress.items():
+            delta = total - last.get(cls, 0)
+            if delta > 0:
+                metrics.REGISTRY.inc("kf_wire_bytes_total", delta,
+                                     link=cls)
+        self._last_link_egress = egress
 
     def latencies(self):
         """RTT (us) to every peer; 0 for self. (reference:

@@ -24,13 +24,17 @@ from .hostspec import (
 from .interval import even_partition
 from .peerlist import PeerList
 from .topology import (
+    STRATEGY_NAMES,
     gen_binary_tree,
     gen_binary_tree_star,
     gen_circular_graph_pair,
     gen_default_reduce_graph,
+    gen_hierarchy_pairs,
     gen_multi_binary_tree_star,
     gen_star_bcast_graph,
+    gen_strategy_pairs,
     gen_tree,
+    resolve_auto,
 )
 
 __all__ = [
@@ -53,4 +57,8 @@ __all__ = [
     "gen_star_bcast_graph",
     "gen_circular_graph_pair",
     "gen_default_reduce_graph",
+    "gen_strategy_pairs",
+    "gen_hierarchy_pairs",
+    "resolve_auto",
+    "STRATEGY_NAMES",
 ]

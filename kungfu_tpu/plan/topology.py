@@ -111,3 +111,104 @@ def gen_default_reduce_graph(bcast: Graph) -> Graph:
     for i in range(g.n):
         g.add_edge(i, i)
     return g
+
+
+#: the full strategy catalog (PAPER.md §strategy); AUTO resolves via
+#: `resolve_auto` before any graph is built
+STRATEGY_NAMES = (
+    "STAR",
+    "RING",
+    "CLIQUE",
+    "TREE",
+    "BINARY_TREE",
+    "BINARY_TREE_STAR",
+    "MULTI_BINARY_TREE_STAR",
+)
+
+
+def resolve_auto(strategy: str, peers: PeerList) -> str:
+    """AUTO -> concrete strategy for this peer list (star on one host,
+    binary-tree-star across hosts); identity otherwise. Mirrors native
+    `resolve_auto` (core.cpp)."""
+    if strategy != "AUTO":
+        return strategy
+    masters, _ = _local_masters(peers)
+    return "STAR" if len(masters) <= 1 else "BINARY_TREE_STAR"
+
+
+def gen_strategy_pairs(strategy: str,
+                       peers: PeerList) -> List[Tuple[Graph, Graph]]:
+    """(reduce, bcast) graph pairs of a named strategy over `peers` —
+    the Python mirror of native `build_strategy` (core.cpp), byte-for-
+    byte in edge order. Chunked traffic round-robins across the pairs
+    by stable name hash, so every rank MUST derive the identical list
+    from its own replica of the PeerList (the schedule-only discipline
+    kfverify's strategy-graph pass checks)."""
+    k = len(peers)
+    s = resolve_auto(strategy.upper(), peers)
+    pairs: List[Tuple[Graph, Graph]] = []
+
+    def from_bcast(b: Graph) -> None:
+        pairs.append((gen_default_reduce_graph(b), b))
+
+    if s == "STAR":
+        from_bcast(gen_star_bcast_graph(k, 0))
+    elif s == "RING":
+        for r in range(k):
+            reduce_g, bcast_g = gen_circular_graph_pair(k, r)
+            pairs.append((reduce_g, bcast_g))
+    elif s == "CLIQUE":
+        for r in range(k):
+            from_bcast(gen_star_bcast_graph(k, r))
+    elif s == "TREE":
+        from_bcast(gen_tree(peers))
+    elif s == "BINARY_TREE":
+        from_bcast(gen_binary_tree(k))
+    elif s == "BINARY_TREE_STAR":
+        from_bcast(gen_binary_tree_star(peers))
+    elif s == "MULTI_BINARY_TREE_STAR":
+        for g in gen_multi_binary_tree_star(peers):
+            from_bcast(g)
+    else:
+        raise ValueError(
+            f"unknown strategy {strategy!r}; valid: "
+            f"{STRATEGY_NAMES + ('AUTO',)}")
+    return pairs
+
+
+def gen_hierarchy_pairs(strategy: str,
+                        peers: PeerList) -> List[Tuple[Graph, Graph]]:
+    """hier(strategy): the KF_HIER=1 decomposition, mirroring native
+    `build_hierarchical` (core.cpp).
+
+    Every (reduce, bcast) pair composes three stages in the full rank
+    space: intra-host reduce (each leaf -> its host master, the edges
+    the shm rings carry), the *configured* strategy's graphs restricted
+    to the masters for the inter-host stage, and intra-host broadcast
+    (master -> leaves). With no colocation (every rank its own host)
+    hier(S) == S exactly. Pure function of (strategy, PeerList): it is
+    re-derived from the live PeerList at every epoch switch/recovery,
+    which is what makes the hierarchy elastically re-plannable.
+    """
+    n = len(peers)
+    masters, host_master = _local_masters(peers)
+    if len(masters) == n:
+        return gen_strategy_pairs(strategy, peers)
+    mpeers = PeerList(peers[m] for m in masters)
+    out: List[Tuple[Graph, Graph]] = []
+    for rg_m, bg_m in gen_strategy_pairs(strategy, mpeers):
+        rg, bg = Graph(n), Graph(n)
+        for g_m, g in ((rg_m, rg), (bg_m, bg)):
+            for i in range(g_m.n):
+                if g_m.is_self_loop(i):
+                    g.add_edge(masters[i], masters[i])
+                for j in g_m.nexts(i):
+                    g.add_edge(masters[i], masters[j])
+        for rank, p in enumerate(peers):
+            m = host_master[p.ipv4]
+            if m == rank:
+                continue
+            rg.add_edge(rank, m)  # intra-host reduce: leaf -> master
+            bg.add_edge(m, rank)  # intra-host bcast: master -> leaves
+        out.append((rg, bg))
+    return out

@@ -11,6 +11,11 @@ gauges it already serves. Families this repo publishes
 - ``kf_wire_bytes_total{collective=...}`` (counter) — payload bytes by
   data path: ``grad`` (bucket pipeline), ``resync`` (elastic
   streaming), plus whatever callers add.
+- ``kf_wire_bytes_total{link=...}`` (counter) — the same traffic
+  attributed by wire link class {``tcp``, ``unix``, ``shm``}, fed from
+  the native per-link counters via ``Peer.publish_link_metrics``
+  (docs/collectives.md): how many bytes the colocated share moved off
+  the socket stack.
 - ``kf_grad_arrival_lag_ms`` (gauge) — how long the gradient
   pipeline's wire executor idled waiting on packer arrivals last step
   (wall - wire: the backpressure signal an adaptive bucket scheduler

@@ -181,6 +181,51 @@ if r.returncode:
 print("GOODPUT GATE OK")
 EOF
 
+echo "== [4f/7] hierarchical + shm collectives smoke: 4 peers over two simulated hosts =="
+# topology-aware collectives (docs/collectives.md): a 2x2-host
+# in-process cluster (127.0.0.1 + 127.0.0.2) under KF_HIER=1 must (a)
+# run hierarchical graphs, (b) sum exactly, (c) move every colocated
+# byte off the socket stack (leaves' egress is 100% shm), and (d)
+# re-derive the hierarchy across an epoch shrink
+timeout 120 python - <<'EOF'
+import threading
+import numpy as np
+from kungfu_tpu.ffi import NativePeer
+import os
+os.environ["KF_HIER"] = "1"
+specs = ["127.0.0.1:26600", "127.0.0.1:26601",
+         "127.0.0.2:26600", "127.0.0.2:26601"]
+spec = ",".join(specs)
+ps = [NativePeer(s, spec, version=0, strategy="STAR", timeout_ms=20000)
+      for s in specs]
+for p in ps:
+    p.start()
+def on_all(fn):
+    out, errs = [None]*4, []
+    def w(i):
+        try: out[i] = fn(ps[i], i)
+        except Exception as e: errs.append(e)
+    ts = [threading.Thread(target=w, args=(i,)) for i in range(4)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    if errs: raise errs[0]
+    return out
+assert all(p.hierarchical for p in ps), "KF_HIER=1 session not hierarchical"
+for r in on_all(lambda p, i: p.all_reduce(
+        np.full(5000, float(i + 1), np.float32), name="smoke")):
+    np.testing.assert_array_equal(r, np.full(5000, 10.0, np.float32))
+for leaf in (1, 3):
+    eg = ps[leaf].link_stats()["egress"]
+    assert eg["shm"] > 0 and eg["tcp"] == 0 and eg["unix"] == 0, eg
+for p in ps[:2]:
+    p.update(",".join(specs[:2]), 1)
+for r in on_all(lambda p, i: p.all_reduce(
+        np.ones(64, np.int64), name="post") if i < 2 else None)[:2]:
+    np.testing.assert_array_equal(r, np.full(64, 2, np.int64))
+for p in ps:
+    p.close()
+print("HIER+SHM SMOKE OK")
+EOF
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

@@ -2,15 +2,18 @@
 # Sanitizer gate for the native runtime (kungfu_tpu/native).
 #
 # Builds the in-proc multi-peer smoke driver (4-peer loopback cluster:
-# concurrent named allreduce rounds, non-root broadcast, in-place
-# broadcast via send==recv aliasing inside Session::broadcast, the
-# compressed-gradient wire round — per-bucket f32 scale negotiation +
-# saturating int8 sum_sat payload, the grad-pipeline protocol — store
-# ops, epoch switch) under each sanitizer and loops it, so the threaded
-# transport/session/peer paths — the class the round-7 Server::stop
-# hang lived in — are exercised under instrumentation, with suppression
-# files from kungfu_tpu/native/sanitize/ (policy: external roots only,
-# kf:: frames are never suppressed).
+# concurrent named allreduce rounds — riding the SHARED-MEMORY ring
+# transport, since colocated peers default to it — non-root broadcast,
+# in-place broadcast via send==recv aliasing inside Session::broadcast,
+# the compressed-gradient wire round — per-bucket f32 scale negotiation
+# + saturating int8 sum_sat payload, the grad-pipeline protocol — store
+# ops, epoch switch, and a KF_HIER=1 hierarchical round over two
+# simulated hosts with link-class byte assertions) under each sanitizer
+# and loops it, so the threaded transport/session/shm-ring/peer paths —
+# the class the round-7 Server::stop hang lived in — are exercised
+# under instrumentation, with suppression files from
+# kungfu_tpu/native/sanitize/ (policy: external roots only, kf::
+# frames are never suppressed).
 #
 # Usage: scripts/sanitize.sh [tidy|asan|ubsan|tsan ...] [--rounds N]
 #   no flavor args = tidy + all three sanitizers. Each round re-runs

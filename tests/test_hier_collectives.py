@@ -1,0 +1,372 @@
+"""Hierarchical collectives + shared-memory intra-host transport.
+
+In-process libkf clusters (the test_control_plane harness shape) pinned
+on the ISSUE-13 acceptance contract (docs/collectives.md):
+
+- the hierarchical+shm all-reduce is BITWISE-identical to the flat path
+  on the same inputs (across transports the graphs are identical, so
+  even float accumulation matches bit for bit; across flat-vs-hier the
+  association changes, so exactness is pinned on integer dtypes and
+  integer-valued floats);
+- colocated traffic moves off the socket stack: link-class byte
+  attribution shows shm egress replacing unix/tcp egress, and the
+  classes always sum to the total;
+- KF_SHM=0 opts out (unix fallback), KF_NO_UNIX_SOCKET=1 forces TCP,
+  both with validated parsing through env.CONFIG_VARS;
+- the hierarchy is re-derived from the PeerList on every epoch switch.
+
+Two simulated hosts = 127.0.0.1 + 127.0.0.2 (both loopback, distinct
+ipv4 => not colocated, exactly how kfrun -H emulates hosts).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kungfu_tpu import env as kfenv
+from kungfu_tpu.ffi import LINK_CLASSES, NativePeer
+
+BASE_PORT = 23300
+_port_lock = threading.Lock()
+_next_port = [BASE_PORT]
+
+
+def alloc_ports(n):
+    with _port_lock:
+        lo = _next_port[0]
+        _next_port[0] += n
+    return list(range(lo, lo + n))
+
+
+def make_cluster(hosts, strategy="AUTO", timeout_ms=20000):
+    """hosts: per-host slot counts, e.g. [2, 2] -> 127.0.0.1 x2 +
+    127.0.0.2 x2. Returns started NativePeers in rank order; each
+    carries its textual rank list as ``.spec`` for epoch updates."""
+    specs = []
+    for h, slots in enumerate(hosts):
+        ports = alloc_ports(slots)
+        specs += [f"127.0.0.{h + 1}:{p}" for p in ports]
+    spec = ",".join(specs)
+    peers = [NativePeer(s, spec, version=0, strategy=strategy,
+                        timeout_ms=timeout_ms) for s in specs]
+    for p in peers:
+        p.spec_list = list(specs)
+        p.start()
+    return peers
+
+
+def run_on_all(peers, fn):
+    results = [None] * len(peers)
+    errors = []
+
+    def work(i):
+        try:
+            results[i] = fn(peers[i], i)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(len(peers))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+def close_all(peers):
+    for p in peers:
+        p.close()
+
+
+def allreduce_rows(peers, payload_per_rank, name="ar"):
+    return run_on_all(
+        peers, lambda p, i: p.all_reduce(payload_per_rank[i], name=name))
+
+
+def rank_payloads(n, size=3000, dtype=np.float32, seed=7,
+                  integer_valued=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.integers(-100, 100, size).astype(dtype) if integer_valued \
+            else rng.standard_normal(size).astype(dtype)
+        out.append(x)
+    return out
+
+
+class TestShmTransport:
+    def test_shm_bitwise_equals_socket_paths(self, monkeypatch):
+        """Same graphs, different wire: shm vs unix vs tcp results are
+        bitwise identical on random floats (transport must never touch
+        the math)."""
+        payload = rank_payloads(3, dtype=np.float32)
+        results = {}
+        for mode, env in (("shm", {}),
+                          ("unix", {"KF_SHM": "0"}),
+                          ("tcp", {"KF_SHM": "0",
+                                   "KF_NO_UNIX_SOCKET": "1"})):
+            for k in ("KF_SHM", "KF_NO_UNIX_SOCKET"):
+                monkeypatch.delenv(k, raising=False)
+            for k, v in env.items():
+                monkeypatch.setenv(k, v)
+            peers = make_cluster([3])
+            try:
+                results[mode] = allreduce_rows(peers, payload)
+            finally:
+                close_all(peers)
+        for mode in ("unix", "tcp"):
+            for a, b in zip(results["shm"], results[mode]):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"shm vs {mode} diverged")
+
+    def test_colocated_bytes_leave_the_socket_stack(self, monkeypatch):
+        """On a fully colocated cluster every collective payload byte
+        rides shm; with KF_SHM=0 the same load is all unix. The link
+        classes always sum to the stats() total."""
+        payload = rank_payloads(3)
+        monkeypatch.delenv("KF_SHM", raising=False)
+        peers = make_cluster([3])
+        try:
+            allreduce_rows(peers, payload)
+            for p in peers:
+                ls = p.link_stats()
+                assert sum(ls["egress"].values()) \
+                    == p.stats()["egress_bytes"]
+                assert ls["egress"]["unix"] == 0
+                assert ls["egress"]["tcp"] == 0
+            assert sum(p.link_stats()["egress"]["shm"]
+                       for p in peers) > 0
+        finally:
+            close_all(peers)
+        monkeypatch.setenv("KF_SHM", "0")
+        peers = make_cluster([3])
+        try:
+            allreduce_rows(peers, payload)
+            assert sum(p.link_stats()["egress"]["shm"]
+                       for p in peers) == 0
+            assert sum(p.link_stats()["egress"]["unix"]
+                       for p in peers) > 0
+        finally:
+            close_all(peers)
+
+    def test_multi_chunk_payload_over_shm(self, monkeypatch):
+        """A >2-chunk buffer (session chunks at 1 MiB) streams through
+        the rings byte-exactly — covers ring wraparound and concurrent
+        chunk-thread writers."""
+        monkeypatch.delenv("KF_SHM", raising=False)
+        n = (5 << 20) // 4 + 13  # ~5 MiB of f32, odd tail
+        rng = np.random.default_rng(3)
+        payload = [rng.standard_normal(n).astype(np.float32)
+                   for _ in range(2)]
+        peers = make_cluster([2])
+        try:
+            out = allreduce_rows(peers, payload, name="big")
+            expect = payload[0] + payload[1]
+            for r in out:
+                np.testing.assert_array_equal(r, expect)
+        finally:
+            close_all(peers)
+
+    def test_shm_survives_epoch_switch(self, monkeypatch):
+        """update() rebuilds the rings under the new token: collectives
+        before AND after a shrink both ride shm."""
+        monkeypatch.delenv("KF_SHM", raising=False)
+        peers = make_cluster([3])
+        try:
+            allreduce_rows(peers, rank_payloads(3))
+            keep = peers[:2]
+            new_list = ",".join(peers[0].spec_list[:2])
+            before = [p.link_stats()["egress"]["shm"] for p in keep]
+            for p in keep:
+                p.update(new_list, 1)
+            out = run_on_all(keep, lambda p, i: p.all_reduce(
+                np.full(2000, float(i + 1), np.float32), name="e1"))
+            for r in out:
+                np.testing.assert_array_equal(
+                    r, np.full(2000, 3.0, np.float32))
+            after = [p.link_stats()["egress"]["shm"] for p in keep]
+            assert all(a > b for a, b in zip(after, before))
+        finally:
+            close_all(peers)
+
+
+class TestHierarchical:
+    @pytest.fixture(autouse=True)
+    def _hier_env(self, monkeypatch):
+        monkeypatch.delenv("KF_SHM", raising=False)
+        monkeypatch.setenv("KF_HIER", "1")
+        yield
+        monkeypatch.delenv("KF_HIER", raising=False)
+
+    @pytest.mark.parametrize("strategy",
+                             ["STAR", "RING", "TREE", "CLIQUE",
+                              "BINARY_TREE", "BINARY_TREE_STAR",
+                              "MULTI_BINARY_TREE_STAR", "AUTO"])
+    def test_hier_allreduce_exact_all_strategies(self, strategy,
+                                                 monkeypatch):
+        """hier(S) x shm over two simulated hosts sums exactly for
+        every S in the catalog (integer-valued floats: association-
+        free, so flat and hier must agree to the bit)."""
+        payload = rank_payloads(4, size=1500, integer_valued=True)
+        expect = sum(payload).astype(np.float32)
+        peers = make_cluster([2, 2], strategy=strategy)
+        try:
+            assert all(p.hierarchical for p in peers)
+            for r in allreduce_rows(peers, payload, name="hx"):
+                np.testing.assert_array_equal(r, expect)
+        finally:
+            close_all(peers)
+
+    def test_hier_bitwise_equals_flat_on_integer_inputs(self,
+                                                        monkeypatch):
+        """The acceptance pin: hier+shm == flat on the same inputs,
+        bitwise, over a real in-process 2x2-host cluster (int64 and
+        integer-valued f32 make the comparison association-free)."""
+        for dtype in (np.int64, np.float32):
+            payload = rank_payloads(4, size=2048, dtype=dtype,
+                                    integer_valued=True)
+            hier = None
+            monkeypatch.setenv("KF_HIER", "1")
+            peers = make_cluster([2, 2], strategy="STAR")
+            try:
+                hier = allreduce_rows(peers, payload, name="ab")
+            finally:
+                close_all(peers)
+            monkeypatch.setenv("KF_HIER", "0")
+            peers = make_cluster([2, 2], strategy="STAR")
+            try:
+                assert not peers[0].hierarchical
+                flat = allreduce_rows(peers, payload, name="ab")
+            finally:
+                close_all(peers)
+            monkeypatch.setenv("KF_HIER", "1")
+            for a, b in zip(hier, flat):
+                np.testing.assert_array_equal(a, b)
+
+    def test_hier_bitwise_across_transports_random_floats(self,
+                                                          monkeypatch):
+        """hier graphs are transport-independent: hier+shm vs hier with
+        sockets agree bitwise on random floats."""
+        payload = rank_payloads(4, size=4096)
+        out = {}
+        for mode, shm in (("shm", None), ("sock", "0")):
+            if shm is None:
+                monkeypatch.delenv("KF_SHM", raising=False)
+            else:
+                monkeypatch.setenv("KF_SHM", shm)
+            peers = make_cluster([2, 2], strategy="RING")
+            try:
+                out[mode] = allreduce_rows(peers, payload, name="ht")
+            finally:
+                close_all(peers)
+        for a, b in zip(out["shm"], out["sock"]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_hier_cuts_socket_bytes(self):
+        """The hierarchy + shm moves the colocated share of bytes off
+        the socket stack: leaves send ONLY via shm; cross-host traffic
+        (tcp) flows between masters alone."""
+        peers = make_cluster([2, 2], strategy="STAR")
+        try:
+            allreduce_rows(peers, rank_payloads(4, size=8192), name="lb")
+            stats = [p.link_stats()["egress"] for p in peers]
+            # leaves (ranks 1, 3): everything to their master via shm
+            for leaf in (1, 3):
+                assert stats[leaf]["shm"] > 0
+                assert stats[leaf]["tcp"] == 0
+                assert stats[leaf]["unix"] == 0
+            # masters exchange the inter-host stage over TCP
+            assert stats[2]["tcp"] > 0
+        finally:
+            close_all(peers)
+
+    def test_rooted_collectives_under_hier(self):
+        peers = make_cluster([2, 2], strategy="BINARY_TREE_STAR")
+        try:
+            out = run_on_all(peers, lambda p, i: p.broadcast(
+                np.full(777, 9 if i == 3 else 0, np.int32), root=3,
+                name="rb"))
+            for r in out:
+                np.testing.assert_array_equal(
+                    r, np.full(777, 9, np.int32))
+            out = run_on_all(peers, lambda p, i: p.reduce(
+                np.full(33, i + 1, np.int64), root=1, name="rr"))
+            np.testing.assert_array_equal(
+                out[1], np.full(33, 10, np.int64))
+            assert all(out[i] is None for i in (0, 2, 3))
+            out = run_on_all(peers, lambda p, i: p.all_gather(
+                np.array([i], np.int32), name="ag"))
+            for r in out:
+                np.testing.assert_array_equal(
+                    r.ravel(), np.arange(4, dtype=np.int32))
+        finally:
+            close_all(peers)
+
+    def test_hierarchy_rederived_on_epoch_switch(self):
+        """Grow/shrink re-plans the hierarchy from the new PeerList:
+        after shrinking away host 2, the survivors' session is still
+        hierarchical-capable but single-host (degenerate), and sums
+        stay exact."""
+        ports = alloc_ports(2)
+        specs = [f"127.0.0.1:{ports[0]}", f"127.0.0.1:{ports[1]}"]
+        more = alloc_ports(2)
+        specs += [f"127.0.0.2:{more[0]}", f"127.0.0.2:{more[1]}"]
+        spec = ",".join(specs)
+        peers = [NativePeer(s, spec, version=0, strategy="AUTO",
+                            timeout_ms=20000) for s in specs]
+        for p in peers:
+            p.start()
+        try:
+            for r in allreduce_rows(peers,
+                                    rank_payloads(4, size=100,
+                                                  integer_valued=True),
+                                    name="g0"):
+                pass
+            survivors = peers[:2]
+            new_spec = ",".join(specs[:2])
+            for p in survivors:
+                p.update(new_spec, 1)
+            assert all(p.hierarchical for p in survivors)
+            out = run_on_all(survivors, lambda p, i: p.all_reduce(
+                np.full(64, i + 1.0, np.float32), name="g1"))
+            for r in out:
+                np.testing.assert_array_equal(
+                    r, np.full(64, 3.0, np.float32))
+        finally:
+            close_all(peers)
+
+
+class TestEnvKnobs:
+    def test_new_vars_in_config_vars(self):
+        for var in ("KF_SHM", "KF_HIER", "KF_NO_UNIX_SOCKET"):
+            assert var in kfenv.CONFIG_VARS
+
+    def test_launcher_forwards_transport_vars(self, monkeypatch):
+        from kungfu_tpu.plan import PeerList
+        monkeypatch.setenv("KF_SHM", "0")
+        monkeypatch.setenv("KF_HIER", "1")
+        monkeypatch.setenv("KF_NO_UNIX_SOCKET", "1")
+        peers = PeerList.parse("127.0.0.1:10000,127.0.0.1:10001")
+        env = kfenv.worker_env(peers[0], peers, version=0)
+        assert env["KF_SHM"] == "0"
+        assert env["KF_HIER"] == "1"
+        assert env["KF_NO_UNIX_SOCKET"] == "1"
+
+    @pytest.mark.parametrize("var", ["KF_SHM", "KF_HIER",
+                                     "KF_NO_UNIX_SOCKET"])
+    def test_garbage_flag_raises_at_bootstrap(self, var):
+        e = {kfenv.SELF_SPEC: "127.0.0.1:10000",
+             kfenv.INIT_PEERS: "127.0.0.1:10000", var: "yes"}
+        with pytest.raises(ValueError, match=var):
+            kfenv.from_env(e)
+
+    def test_env_flag_parsing(self):
+        assert kfenv.env_flag("KF_SHM", True, {}) is True
+        assert kfenv.env_flag("KF_SHM", True, {"KF_SHM": "0"}) is False
+        assert kfenv.env_flag("KF_SHM", False, {"KF_SHM": "1"}) is True
+        with pytest.raises(ValueError, match="KF_SHM"):
+            kfenv.env_flag("KF_SHM", True, {"KF_SHM": "maybe"})

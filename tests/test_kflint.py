@@ -26,6 +26,7 @@ from kungfu_tpu.analysis import vmem_budget
 from kungfu_tpu.analysis.protocol import (CollectiveOrderPass,
                                           LockOrderPass,
                                           SchedulePurityPass,
+                                          StrategyGraphPass,
                                           WireNameDeterminismPass)
 from kungfu_tpu.analysis.protocol import explore
 
@@ -1139,6 +1140,102 @@ def test_schedule_purity_quiet_on_pure_scenario_compiler():
         def replay(spec):
             return compile_scenario(spec)
     """})
+    assert findings == []
+
+
+# -- kfverify: strategy-graph ------------------------------------------------
+
+
+def test_strategy_graph_fires_on_rank_divergent_generator():
+    # the acceptance fixture (ISSUE 13): a topology generator that
+    # consults "who am I" builds per-rank graphs — rank A waits on an
+    # edge rank B never drew, a deadlock with no error message
+    findings = fire_project(StrategyGraphPass(), **{"topo.py": """
+        import os
+        import socket
+
+        def gen_fast_tree(peers, cfg):
+            g = Graph(len(peers))
+            me = cfg.rank
+            for r in range(len(peers)):
+                if r != me:
+                    g.add_edge(me, r)
+            return g
+
+        def gen_host_ring(peers):
+            g = Graph(len(peers))
+            first = socket.gethostname()
+            return g, first
+
+        def gen_tuned_star(peers):
+            k = len(peers)
+            root = int(os.environ.get("KF_ROOT", "0"))
+            g = Graph(k)
+            for i in range(k):
+                if i != root:
+                    g.add_edge(root, i)
+            return g
+    """})
+    msgs = " ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "rank-identity read .rank" in msgs
+    assert "host-identity call socket.gethostname()" in msgs
+    assert "env read" in msgs
+    assert all(f.pass_name == "strategy-graph" for f in findings)
+
+
+def test_strategy_graph_fires_on_clock_in_generator():
+    findings = fire_project(StrategyGraphPass(), **{"topo.py": """
+        import time
+
+        def gen_rotating_ring(peers):
+            g = Graph(len(peers))
+            r = int(time.time()) % len(peers)
+            for i in range(1, len(peers)):
+                g.add_edge((r + i - 1) % g.n, (r + i) % g.n)
+            return g
+    """})
+    assert len(findings) == 1
+    assert "nondeterministic call" in findings[0].message
+
+
+def test_strategy_graph_quiet_on_replica_pure_generator():
+    # the shipped shape: graphs from the PeerList replica alone;
+    # PeerList.rank(q) as a METHOD CALL is the pure peer->index map
+    findings = fire_project(StrategyGraphPass(), **{"topo.py": """
+        def _local_masters(peers):
+            masters, host_master = [], {}
+            for rank, p in enumerate(peers):
+                if p.ipv4 not in host_master:
+                    host_master[p.ipv4] = rank
+                    masters.append(rank)
+            return masters, host_master
+
+        def gen_tree(peers):
+            g = Graph(len(peers))
+            masters, host_master = _local_masters(peers)
+            for rank, p in enumerate(peers):
+                if host_master[p.ipv4] != rank:
+                    g.add_edge(host_master[p.ipv4], rank)
+            for m in masters[1:]:
+                g.add_edge(masters[0], m)
+            return g
+
+        def gen_rooted_star(peers, root_peer):
+            root = peers.rank(root_peer)
+            g = Graph(len(peers))
+            for i in range(len(peers)):
+                if i != root:
+                    g.add_edge(root, i)
+            return g
+    """})
+    assert findings == []
+
+
+def test_strategy_graph_quiet_on_shipped_tree():
+    # the real generators (plan/topology.py + friends) must stay clean
+    findings = [f for f in run_paths([PKG])
+                if f.pass_name == "strategy-graph"]
     assert findings == []
 
 

@@ -1,0 +1,250 @@
+"""Property tests for the communication-topology generators.
+
+The generators in ``plan/topology.py`` are schedule data (kfverify's
+strategy-graph discipline): every rank derives the identical graphs
+from the same PeerList, so the properties under test are exactly the
+cross-rank contract — determinism from the replica alone, one master
+per host, locality (cross-host edges only between masters), coverage
+(every collective reaches every rank), and clean re-derivation after a
+shrink/grow. Until this file only the native side exercised them,
+indirectly, through live clusters.
+"""
+
+import itertools
+
+import pytest
+
+from kungfu_tpu.plan import (
+    STRATEGY_NAMES,
+    Graph,
+    PeerList,
+    gen_default_reduce_graph,
+    gen_hierarchy_pairs,
+    gen_strategy_pairs,
+    resolve_auto,
+)
+from kungfu_tpu.plan.topology import _local_masters
+
+#: host layouts: (name, peer spec) — single host, balanced multi-host,
+#: lopsided, and one-peer-per-host (the no-colocation degenerate case)
+LAYOUTS = {
+    "one-host-4": "10.0.0.1:1,10.0.0.1:2,10.0.0.1:3,10.0.0.1:4",
+    "two-hosts-2x2": "10.0.0.1:1,10.0.0.1:2,10.0.0.2:1,10.0.0.2:2",
+    "lopsided-3+1": "10.0.0.1:1,10.0.0.1:2,10.0.0.1:3,10.0.0.2:1",
+    "three-hosts-mixed": ("10.0.0.1:1,10.0.0.2:1,10.0.0.2:2,"
+                          "10.0.0.3:1,10.0.0.3:2,10.0.0.3:3"),
+    "all-distinct": "10.0.0.1:1,10.0.0.2:1,10.0.0.3:1,10.0.0.4:1",
+}
+
+
+def reachable_from(g: Graph, root: int) -> set:
+    seen, frontier = {root}, [root]
+    while frontier:
+        i = frontier.pop()
+        for j in g.nexts(i):
+            if j not in seen:
+                seen.add(j)
+                frontier.append(j)
+    return seen
+
+
+def assert_acyclic(g: Graph):
+    state = [0] * g.n  # 0 unvisited, 1 in stack, 2 done
+
+    def visit(i):
+        state[i] = 1
+        for j in g.nexts(i):
+            assert state[j] != 1, f"cycle through {j} in {g!r}"
+            if state[j] == 0:
+                visit(j)
+        state[i] = 2
+
+    for i in range(g.n):
+        if state[i] == 0:
+            visit(i)
+
+
+@pytest.mark.parametrize("layout", sorted(LAYOUTS))
+@pytest.mark.parametrize("strategy", STRATEGY_NAMES + ("AUTO",))
+@pytest.mark.parametrize("hier", [False, True])
+class TestGeneratorProperties:
+    def _pairs(self, strategy, peers, hier):
+        gen = gen_hierarchy_pairs if hier else gen_strategy_pairs
+        return gen(strategy, peers)
+
+    def test_every_rank_derives_identical_graphs(self, layout, strategy,
+                                                 hier):
+        """The rank-identity property: two independent derivations from
+        equal PeerList replicas (what two ranks do) are equal, pair by
+        pair, in reduce AND bcast graphs."""
+        a = self._pairs(strategy, PeerList.parse(LAYOUTS[layout]), hier)
+        b = self._pairs(strategy, PeerList.parse(LAYOUTS[layout]), hier)
+        assert len(a) == len(b) >= 1
+        for (ra, ba), (rb, bb) in zip(a, b):
+            assert ra == rb and ba == bb
+            # edge ORDER is part of the contract too (float
+            # accumulation order): Graph.__eq__ sorts, so compare raw
+            assert [list(ra.nexts(i)) for i in range(ra.n)] \
+                == [list(rb.nexts(i)) for i in range(rb.n)]
+
+    def test_bcast_covers_every_rank(self, layout, strategy, hier):
+        """Each bcast graph reaches all ranks from its root(s); the
+        matching reduce graph drains all ranks into them."""
+        peers = PeerList.parse(LAYOUTS[layout])
+        for rg, bg in self._pairs(strategy, peers, hier):
+            roots = [i for i in range(bg.n)
+                     if not list(bg.prevs(i))]
+            covered = set()
+            for r in roots:
+                covered |= reachable_from(bg, r)
+            assert covered == set(range(len(peers)))
+            # reduce is the reverse relation: same coverage backwards
+            for r in roots:
+                assert reachable_from(rg.reverse(), r) == covered
+
+    def test_graphs_acyclic(self, layout, strategy, hier):
+        peers = PeerList.parse(LAYOUTS[layout])
+        for rg, bg in self._pairs(strategy, peers, hier):
+            assert_acyclic(bg)
+            assert_acyclic(rg)
+
+
+@pytest.mark.parametrize("strategy", STRATEGY_NAMES)
+def test_hier_cross_host_edges_only_between_masters(strategy):
+    """The locality rule that makes the hierarchy worth having: in
+    hier(S), an edge between two hosts always connects their masters."""
+    peers = PeerList.parse(LAYOUTS["three-hosts-mixed"])
+    masters, host_master = _local_masters(peers)
+    assert sorted(set(host_master.values())) == sorted(masters)
+    for rg, bg in gen_hierarchy_pairs(strategy, peers):
+        for g in (rg, bg):
+            for i, j in g.edges():
+                if peers[i].ipv4 != peers[j].ipv4:
+                    assert i in masters and j in masters, (
+                        f"{strategy}: cross-host edge {i}->{j} "
+                        "touches a non-master")
+
+
+def test_exactly_one_master_per_host():
+    for spec in LAYOUTS.values():
+        peers = PeerList.parse(spec)
+        masters, host_master = _local_masters(peers)
+        hosts = {p.ipv4 for p in peers}
+        assert len(masters) == len(hosts)
+        # the master of a host lives on it, and is its first rank
+        for ip, m in host_master.items():
+            assert peers[m].ipv4 == ip
+            assert m == min(r for r, p in enumerate(peers)
+                            if p.ipv4 == ip)
+
+
+@pytest.mark.parametrize("strategy", STRATEGY_NAMES)
+def test_hier_equals_flat_without_colocation(strategy):
+    """With every rank on its own host there is nothing to decompose:
+    hier(S) must equal S exactly (same pairs, same edge order)."""
+    peers = PeerList.parse(LAYOUTS["all-distinct"])
+    flat = gen_strategy_pairs(strategy, peers)
+    hier = gen_hierarchy_pairs(strategy, peers)
+    assert len(flat) == len(hier)
+    for (rf, bf), (rh, bh) in zip(flat, hier):
+        assert rf == rh and bf == bh
+
+
+def test_rederivation_after_shrink_and_grow():
+    """The elastic re-plan property: the hierarchy of a shrunken or
+    re-grown PeerList equals a fresh derivation from that list — no
+    state leaks from the previous epoch's graphs."""
+    full = PeerList.parse(LAYOUTS["two-hosts-2x2"])
+    shrunk = PeerList(p for i, p in enumerate(full) if i != 3)
+    regrown = PeerList(list(shrunk) + [full[3]])
+    for strategy in STRATEGY_NAMES:
+        before = gen_hierarchy_pairs(strategy, full)
+        after_shrink = gen_hierarchy_pairs(strategy, shrunk)
+        assert all(rg.n == 3 and bg.n == 3 for rg, bg in after_shrink)
+        # regrowing to the same membership (order restored) gives back
+        # the original graphs
+        again = gen_hierarchy_pairs(strategy, regrown)
+        assert len(again) == len(before)
+        for (ra, ba), (rb, bb) in zip(again, before):
+            assert ra == rb and ba == bb
+
+
+def test_resolve_auto():
+    one_host = PeerList.parse(LAYOUTS["one-host-4"])
+    multi = PeerList.parse(LAYOUTS["two-hosts-2x2"])
+    assert resolve_auto("AUTO", one_host) == "STAR"
+    assert resolve_auto("AUTO", multi) == "BINARY_TREE_STAR"
+    assert resolve_auto("RING", multi) == "RING"
+
+
+def test_reduce_is_reverse_of_bcast_plus_self_loops():
+    peers = PeerList.parse(LAYOUTS["two-hosts-2x2"])
+    for strategy in ("STAR", "TREE", "BINARY_TREE_STAR"):
+        for rg, bg in gen_strategy_pairs(strategy, peers):
+            expect = gen_default_reduce_graph(bg)
+            assert rg == expect
+
+
+def test_ring_pairs_rotate_roots():
+    peers = PeerList.parse(LAYOUTS["one-host-4"])
+    pairs = gen_strategy_pairs("RING", peers)
+    assert len(pairs) == 4
+    # each rotation ends its reduce chain at a different rank
+    sinks = []
+    for rg, _ in pairs:
+        sinks.extend(i for i in range(rg.n) if not list(rg.nexts(i)))
+    assert sorted(sinks) == [0, 1, 2, 3]
+
+
+def test_hier_pair_count_matches_master_level_strategy():
+    """Chunk spreading survives the composition: hier(S) has exactly as
+    many pairs as S over the master list."""
+    peers = PeerList.parse(LAYOUTS["three-hosts-mixed"])
+    masters, _ = _local_masters(peers)
+    mpeers = PeerList(peers[m] for m in masters)
+    for strategy in STRATEGY_NAMES:
+        assert len(gen_hierarchy_pairs(strategy, peers)) \
+            == len(gen_strategy_pairs(strategy, mpeers))
+
+
+def test_unknown_strategy_raises():
+    with pytest.raises(ValueError, match="unknown strategy"):
+        gen_strategy_pairs("MOEBIUS", PeerList.parse(LAYOUTS["one-host-4"]))
+
+
+def test_strategy_pairs_cross_check_edge_counts():
+    """Spot-check shapes against the documented catalog at k=4."""
+    peers = PeerList.parse(LAYOUTS["one-host-4"])
+    star = gen_strategy_pairs("STAR", peers)
+    assert len(star) == 1 and len(star[0][1].edges()) == 3
+    clique = gen_strategy_pairs("CLIQUE", peers)
+    assert len(clique) == 4
+    bt = gen_strategy_pairs("BINARY_TREE", peers)
+    assert len(bt[0][1].edges()) == 3  # heap over 4 nodes
+
+
+def test_hier_intra_edges_ride_masters():
+    """In hier(STAR) over 2x2, the leaves' only reduce edge goes to
+    their colocated master — the edge class the shm rings carry."""
+    peers = PeerList.parse(LAYOUTS["two-hosts-2x2"])
+    (rg, bg), = gen_hierarchy_pairs("STAR", peers)
+    assert list(rg.nexts(1)) == [0]
+    assert list(rg.nexts(3)) == [2]
+    assert 1 in bg.nexts(0) and 3 in bg.nexts(2)
+    # inter-host edges: exactly between masters 0 and 2
+    cross = [(i, j) for i, j in rg.edges()
+             if peers[i].ipv4 != peers[j].ipv4]
+    assert cross == [(2, 0)]
+
+
+def test_layout_permutations_change_graphs_not_contract():
+    """Permuting rank order changes masters (first-seen rule) but never
+    the structural contract — every permutation still yields identical
+    re-derivation and full coverage."""
+    base = LAYOUTS["lopsided-3+1"].split(",")
+    for perm in itertools.permutations(base):
+        peers = PeerList.parse(",".join(perm))
+        for rg, bg in gen_hierarchy_pairs("TREE", peers):
+            roots = [i for i in range(bg.n) if not list(bg.prevs(i))]
+            assert len(roots) == 1
+            assert reachable_from(bg, roots[0]) == set(range(len(peers)))
