@@ -48,8 +48,8 @@ import tempfile
 import time
 
 #: the sweep members; flaky_net needs netns (scripts/chaos.sh only)
-SCENARIOS = ("spot_preempt", "spot_kill_regrow", "diurnal",
-             "straggler_transient")
+SCENARIOS = ("spot_preempt", "spot_kill_regrow", "spot_host_kill",
+             "diurnal", "straggler_transient")
 
 
 def _decompose_dir(trace_dir: str, device_batch: int):

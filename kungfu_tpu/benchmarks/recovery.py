@@ -15,6 +15,19 @@ breakdown VERDICT r5 item 7 asked for on the elastic path:
 
 Usage:  python -m kungfu_tpu.benchmarks.recovery [--runs 3]
             [--np 3] [--crash-rank 1] [--crash-step 5] [--json]
+        python -m kungfu_tpu.benchmarks.recovery --hier-matrix
+            [--runs 3] [--publish]
+
+``--hier-matrix`` is the topology-aware death matrix (BASELINE
+`failure_recovery_mttr_hier`): np=4 over TWO emulated hosts
+(127.0.0.1:2 + 127.0.0.2:2, one kfrun per host) with KF_HIER=1 and
+the shm rings on the wire, killing in turn a host MASTER (rank 2 —
+every leaf on its host loses its ring peer and the inter-host edge),
+a LEAF (rank 3 — the smallest blast radius), and a WHOLE HOST (the
+``crash_host`` chaos fault — master, leaves and rings at once; the
+host's runner reaps the burst as ONE shrunken proposal). Each shape
+publishes the same kftrace-decomposed phase rows as the flat np=3
+benchmark, so the hierarchy's failure cost is attributable per role.
 
 Every phase is attributable to a mechanism with a knob: `detect` is the
 runner's 0.25 s supervision poll; `adopt` is the survivors' recovery
@@ -121,18 +134,21 @@ def check_agreement(a: Dict[str, float], b: Dict[str, float],
 
 
 def run_once(np_: int, crash_rank: int, crash_step: int,
-             port_range: str, trace: bool = True) -> Dict[str, float]:
+             port_range: str, trace: bool = True,
+             hosts: str = "", crash_host: Optional[int] = None,
+             extra_env: Optional[Dict[str, str]] = None
+             ) -> Dict[str, float]:
     from ..elastic.harness import run_survivor_recovery
 
     with tempfile.TemporaryDirectory() as td:
-        extra_env = None
+        env = dict(extra_env or {})
         if trace:
-            extra_env = {"KF_TRACE": "1", "KF_TRACE_DIR": td}
+            env.update({"KF_TRACE": "1", "KF_TRACE_DIR": td})
         logs = run_survivor_recovery(
             crash_rank=crash_rank, crash_step=crash_step,
             total_steps=crash_step + 7, start_np=np_,
             port_range=port_range, timeout=300,
-            extra_env=extra_env)
+            extra_env=env or None, hosts=hosts, crash_host=crash_host)
         d_markers = decompose(logs)
         d_events = decompose_events(td) if trace else None
     if d_markers is None and d_events is None:
@@ -150,6 +166,90 @@ def run_once(np_: int, crash_rank: int, crash_step: int,
     return d
 
 
+#: the topology-aware death matrix: np=4 over two emulated hosts
+#: (ranks 0,1 on host 0 / ranks 2,3 on host 1) under KF_HIER=1 with
+#: the shm rings carrying the intra-host edges. Shapes kill host 1's
+#: MASTER (its leaf loses its ring peer AND the host loses its
+#: inter-host edge — the survivor on host 1 is promoted to master by
+#: the recovery re-derivation), a LEAF (smallest blast radius), and
+#: the WHOLE HOST (the crash_host burst; the host's runner proposes
+#: ONE shrink and lingers for the re-grow).
+HIER_HOSTS = "127.0.0.1:2,127.0.0.2:2"
+HIER_SHAPES = (
+    ("master_death", {"crash_rank": 2}),
+    ("leaf_death", {"crash_rank": 3}),
+    ("host_death", {"crash_host": 1}),
+)
+
+
+def hier_matrix_main(args) -> int:
+    """The failure_recovery_mttr_hier matrix (docs/fault_tolerance.md):
+    per-shape MTTR rows decomposed from kftrace events exactly like
+    the flat np=3 benchmark."""
+    rows: Dict[str, Dict[str, float]] = {}
+    source = "markers"
+    for shape, kw in HIER_SHAPES:
+        per = []
+        for i in range(args.runs):
+            d = run_once(4, kw.get("crash_rank", 0), args.crash_step,
+                         args.port_range, trace=not args.no_trace,
+                         hosts=HIER_HOSTS,
+                         crash_host=kw.get("crash_host"),
+                         extra_env={"KF_HIER": "1"})
+            per.append(d)
+            source = d.get("source", source)
+            print(
+                f"{shape} run {i + 1}/{args.runs}: "
+                f"mttr={d['mttr_ms']:.0f} ms (detect "
+                f"{d['detect_ms']:.0f} + propose {d['propose_ms']:.0f}"
+                f" + consensus {d['consensus_ms']:.0f} + restore "
+                f"{d['restore_ms']:.0f} + resume {d['resume_ms']:.0f})",
+                flush=True)
+        rows[shape] = {
+            k: round(statistics.median(r[k] for r in per), 1)
+            for k in per[0] if isinstance(per[0][k], (int, float))}
+    result = {
+        "benchmark": "failure_recovery_mttr_hier",
+        "np": 4,
+        "hosts": HIER_HOSTS,
+        "hier": True,
+        "shm": True,
+        "runs": args.runs,
+        "crash_step": args.crash_step,
+        "source": source,
+        "note": ("np=4 over two emulated loopback hosts (one kfrun "
+                 "per host) with KF_HIER=1 and shm rings on the "
+                 "intra-host edges; 1-core container, so absolute "
+                 "times include core contention — the per-shape "
+                 "STRUCTURE (which phases grow per death role) is "
+                 "the portable result"),
+        "rows": rows,
+    }
+    print(json.dumps(result), flush=True)
+    if args.publish:
+        from .publish import publish_result
+
+        publish_result(
+            "failure_recovery_mttr_hier", result,
+            parsed={
+                "metric": "hier_host_death_mttr_ms",
+                "value": rows["host_death"]["mttr_ms"],
+                "unit": ("median ms, whole-host SIGKILL -> first "
+                         "post-recovery collective (np=4, hier+shm, "
+                         "two emulated hosts)"),
+                "details": {
+                    "master_death_mttr_ms":
+                        rows["master_death"]["mttr_ms"],
+                    "leaf_death_mttr_ms": rows["leaf_death"]["mttr_ms"],
+                    "source": source,
+                    "caveat": "1-core loopback; see BASELINE.md",
+                },
+            },
+            cmd=("python -m kungfu_tpu.benchmarks.recovery "
+                 "--hier-matrix --publish"))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--runs", type=int, default=3)
@@ -163,7 +263,16 @@ def main(argv=None) -> int:
     ap.add_argument("--no-trace", action="store_true",
                     help="markers-only decomposition (skip kftrace "
                          "flight recording and the agreement check)")
+    ap.add_argument("--hier-matrix", action="store_true",
+                    help="master/leaf/whole-host death MTTR at np=4 "
+                         "over two emulated hosts under KF_HIER=1 "
+                         "(BASELINE failure_recovery_mttr_hier)")
+    ap.add_argument("--publish", action="store_true",
+                    help="with --hier-matrix: merge into BASELINE.json"
+                         " and emit the round's BENCH_rNN.json")
     args = ap.parse_args(argv)
+    if args.hier_matrix:
+        return hier_matrix_main(args)
 
     rows = []
     for i in range(args.runs):

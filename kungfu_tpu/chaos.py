@@ -23,6 +23,7 @@ Schedule format (``KF_CHAOS`` inline JSON, or ``KF_CHAOS_FILE`` path)::
 
     {"seed": 0, "faults": [
         {"type": "crash_worker", "rank": 1, "step": 5, "signal": "KILL"},
+        {"type": "crash_host", "host": 1, "step": 5, "signal": "KILL"},
         {"type": "refuse_http", "path": "/put", "count": 3, "status": 503},
         {"type": "delay_http", "path": "/get", "ms": 200, "count": 2},
         {"type": "die_config_server", "after_requests": 10},
@@ -33,6 +34,15 @@ Schedule format (``KF_CHAOS`` inline JSON, or ``KF_CHAOS_FILE`` path)::
          "to_step": 8, "ms": 120, "count": 5},
         {"type": "preempt_warning", "step": 6, "lead_steps": 2}
     ]}
+
+``crash_host`` is whole-host spot reclamation: every rank whose
+HOST index matches (first-seen order over the PeerList's distinct
+IPv4s — `Peer.host_index`, identical on every rank's replica) kills
+itself at the step, so one scheduled fault takes out the entire
+colocated set — host master, leaves, and their shm rings — at one
+step boundary. Survivors on other hosts detect via ring hello-EOF /
+socket error and ride the survivor-recovery path
+(docs/fault_tolerance.md "host death").
 
 ``straggler_worker`` models a slow host: the matching rank sleeps
 ``ms`` at every step boundary inside [from_step, to_step] (``count``
@@ -71,6 +81,7 @@ ENV_FILE = "KF_CHAOS_FILE"
 
 _KNOWN_TYPES = {
     "crash_worker",
+    "crash_host",
     "refuse_http",
     "delay_http",
     "die_config_server",
@@ -224,9 +235,12 @@ def _fire(ftype: str, **info) -> None:
 
 # -- hook points --------------------------------------------------------------
 
-def on_step(rank: int, step: int) -> None:
-    """ElasticCallback.after_step (entry): scheduled worker crashes and
-    preemption warnings fire here."""
+def on_step(rank: int, step: int, host: Optional[int] = None) -> None:
+    """ElasticCallback.after_step (entry): scheduled worker crashes,
+    whole-host crashes and preemption warnings fire here. ``host`` is
+    this rank's host index (`Peer.host_index`): every colocated rank
+    passes the same value, so one ``crash_host`` fault SIGKILLs the
+    entire emulated host at one step boundary."""
     sched = active()
     if sched is None:
         return
@@ -239,16 +253,25 @@ def on_step(rank: int, step: int) -> None:
         _fire("preempt_warning", rank=rank, step=step,
               lead_steps=int(f.spec.get("lead_steps", 0)))
     f = sched.take("crash_worker", rank=rank, step=step)
+    ftype = "crash_worker"
+    if f is None and host is not None:
+        # host-scoped spot reclamation: each process consults its OWN
+        # schedule replica, so every rank on the matching host consumes
+        # its copy of the fault and dies at the same step boundary —
+        # master, leaves, and their shm rings all at once
+        f = sched.take("crash_host", host=host, step=step)
+        ftype = "crash_host"
     if f is None:
         return
     sig = str(f.spec.get("signal", "KILL")).upper()
-    _fire("crash_worker", rank=rank, step=step, signal=sig)
+    _fire(ftype, rank=rank, step=step, signal=sig,
+          **({"host": host} if ftype == "crash_host" else {}))
     # flight-record the ring BEFORE the destructive action: a SIGKILL
     # leaves no second chance, and the dump carries the chaos event
     # _fire just emitted — the crash instant, from the victim itself
     from . import trace
 
-    trace.flight_dump(reason=f"chaos-crash_worker-{sig}")
+    trace.flight_dump(reason=f"chaos-{ftype}-{sig}")
     if sig == "EXIT":
         os._exit(int(f.spec.get("code", 41)))
     os.kill(os.getpid(), getattr(signal, f"SIG{sig}", signal.SIGKILL))

@@ -19,6 +19,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import time
 
 CONTINUITY_MARKERS = (
     # marker -> what its absence means
@@ -80,11 +81,19 @@ def _run_continuity_cluster(schedule: str,
                             extra_env: dict | None = None,
                             extra_flags: list | None = None,
                             expect_rc: int = 0,
-                            server=None) -> str:
+                            server=None,
+                            hosts: str = "") -> str:
     """Boot config server + kfrun -w + continuity_worker; assert the
     given marker set against the combined runner+worker logs. Pass a
     running `server` (e.g. one with an in-process chaos schedule) to
-    keep its lifecycle with the caller."""
+    keep its lifecycle with the caller.
+
+    ``hosts``: a multi-host spec like ``"127.0.0.1:2,127.0.0.2:2"``
+    launches ONE kfrun per listed host ip with ``-self`` (each runner
+    spawns only the workers scheduled on its own emulated host — the
+    test_multirunner shape), so host-scoped failures have a real
+    per-host supervisor to detect them. Empty = the single-runner
+    single-host launch every pre-existing caller uses."""
     ensure_libkf()
     from .config_server import ConfigServer
 
@@ -105,32 +114,81 @@ def _run_continuity_cluster(schedule: str,
         env["TEST_TOTAL_STEPS"] = str(total_steps)
         if extra_env:
             env.update(extra_env)
-        r = subprocess.run(
-            [sys.executable, "-m", "kungfu_tpu.run",
-             "-np", str(start_np), "-H", f"127.0.0.1:{slots}",
-             "-port-range", port_range,
-             "-w", "-config-server", server.get_url,
-             "-logdir", logdir, "-q"]
-            + (extra_flags or [])
-            + ["--", sys.executable, "-m",
-               "kungfu_tpu.elastic.continuity_worker"],
-            cwd=_REPO, env=env, timeout=timeout, capture_output=True,
-            text=True)
+        base = [sys.executable, "-m", "kungfu_tpu.run",
+                "-np", str(start_np),
+                "-H", hosts or f"127.0.0.1:{slots}",
+                "-port-range", port_range,
+                "-w", "-config-server", server.get_url,
+                "-logdir", logdir, "-q"]
+        tail = (extra_flags or []) + [
+            "--", sys.executable, "-m",
+            "kungfu_tpu.elastic.continuity_worker"]
+        ips = ([h.split(":")[0] for h in hosts.split(",")]
+               if hosts and "," in hosts else [""])
+        procs = []
+        for ip in ips:
+            cmd = list(base) + (["-self", ip] if ip else []) + tail
+            procs.append((ip, subprocess.Popen(
+                cmd, cwd=_REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True)))
+        # drain every runner's pipes CONCURRENTLY: waiting on runner A
+        # while runner B fills its ~64KB pipe buffer would block B —
+        # and, since the runners' workers rendezvous with each other,
+        # deadlock the whole cluster into a spurious timeout
+        import threading
+
+        outputs = {}
+
+        def _drain(ip, p):
+            outputs[ip] = p.communicate()
+
+        drains = [threading.Thread(target=_drain, args=(ip, p),
+                                   daemon=True) for ip, p in procs]
+        for t in drains:
+            t.start()
+        deadline = time.monotonic() + timeout
+        for t in drains:
+            t.join(timeout=max(1.0, deadline - time.monotonic()))
+        # past the deadline with a runner still alive = the cluster
+        # HUNG: kill it and raise TimeoutExpired unconditionally (the
+        # old subprocess.run semantics) — the kill's rc=-9 must never
+        # fall through and satisfy an expect_rc="nonzero" phase,
+        # masking a hang as the expected crash
+        timed_out = [ip for ip, p in procs if p.poll() is None]
+        if timed_out:
+            for _ip, p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for t in drains:
+                t.join(timeout=30.0)
+            raise subprocess.TimeoutExpired(
+                cmd="kfrun " + ",".join(ip or "local"
+                                        for ip in timed_out),
+                timeout=timeout)
+        for t in drains:  # all procs exited: let the stores land
+            t.join(timeout=30.0)
+        results = [(ip, p.returncode, *(outputs.get(ip) or ("", "")))
+                   for ip, p in procs]
         logs = ""
         for f in sorted(os.listdir(logdir)):
             if f.endswith(".log"):
                 with open(os.path.join(logdir, f)) as fh:
                     logs += f"--- {f} ---\n" + fh.read()
         # runner stdout carries the KF_MTTR detect/proposed markers
-        logs += f"--- runner ---\n{r.stdout}"
-        bad = (r.returncode == 0 if expect_rc == "nonzero"
-               else r.returncode != expect_rc)
+        all_out = all_err = ""
+        for ip, _rc, out, err in results:
+            logs += f"--- runner {ip or 'local'} ---\n{out}"
+            all_out += out
+            all_err += err
+        rcs = [rc for _ip, rc, _o, _e in results]
+        bad = (all(rc == 0 for rc in rcs) if expect_rc == "nonzero"
+               else any(rc != expect_rc for rc in rcs))
         if bad:
             raise AssertionError(
-                f"elastic continuity run failed rc={r.returncode} "
+                f"elastic continuity run failed rcs={rcs} "
                 f"(expected {expect_rc}):\n"
-                f"stdout: {r.stdout[-2000:]}\n"
-                f"stderr: {r.stderr[-2000:]}\n{logs[-2000:]}")
+                f"stdout: {all_out[-2000:]}\n"
+                f"stderr: {all_err[-2000:]}\n{logs[-2000:]}")
         for marker, why in markers:
             if marker not in logs:
                 raise AssertionError(
@@ -250,7 +308,9 @@ def run_survivor_recovery(crash_rank: int = 1,
                           port_range: str = "27100-27999",
                           timeout: int = 600,
                           logdir: str | None = None,
-                          extra_env: dict | None = None) -> str:
+                          extra_env: dict | None = None,
+                          hosts: str = "",
+                          crash_host: int | None = None) -> str:
     """Kill one worker mid-training via a chaos schedule and assert the
     survivors shrink membership, restore state, and finish the run with
     loss continuity — no operator action. The full recovery pipeline is
@@ -265,13 +325,23 @@ def run_survivor_recovery(crash_rank: int = 1,
     asserted scenario (the reference's respawn-from-survivors model);
     it happens strictly AFTER the `KF_MTTR resumed` marker, so the MTTR
     window measured by benchmarks/recovery.py never includes the
-    joiner's boot."""
+    joiner's boot.
+
+    ``crash_host`` (with a multi-host ``hosts`` spec) switches the
+    fault to whole-host spot reclamation: EVERY rank on that emulated
+    host SIGKILLs itself at `crash_step` (the ``crash_host`` chaos
+    fault), its runner reaps the burst and proposes ONE shrunken
+    stage, and the cross-host survivors recover — the host-death shape
+    of the same state machine."""
     import json as _json
 
-    chaos_spec = _json.dumps({"faults": [{
-        "type": "crash_worker", "rank": crash_rank, "step": crash_step,
-        "signal": "KILL",
-    }]})
+    if crash_host is not None:
+        fault = {"type": "crash_host", "host": crash_host,
+                 "step": crash_step, "signal": "KILL"}
+    else:
+        fault = {"type": "crash_worker", "rank": crash_rank,
+                 "step": crash_step, "signal": "KILL"}
+    chaos_spec = _json.dumps({"faults": [fault]})
     return _run_continuity_cluster(
         # flat schedule: the only UNPLANNED switch is the recovery; the
         # re-grow back to start_np afterwards is schedule-driven
@@ -294,4 +364,5 @@ def run_survivor_recovery(crash_rank: int = 1,
             **(extra_env or {}),
         },
         extra_flags=["-recover"],
+        hosts=hosts,
     )

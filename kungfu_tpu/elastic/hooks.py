@@ -86,10 +86,12 @@ class ElasticCallback:
         # every event this process emits from here on is attributed to
         # the step that is actually running
         trace.set_context(step=st.step, version=self.peer.version)
-        # deterministic fault injection: a scheduled crash_worker fault
-        # for (rank, step) fires here, so chaos tests drive the SAME
-        # step boundary production failures hit (kungfu_tpu/chaos.py)
-        chaos.on_step(self.peer.rank, st.step)
+        # deterministic fault injection: a scheduled crash_worker (or
+        # host-scoped crash_host) fault for (rank/host, step) fires
+        # here, so chaos tests drive the SAME step boundary production
+        # failures hit (kungfu_tpu/chaos.py)
+        chaos.on_step(self.peer.rank, st.step,
+                      host=self.peer.host_index)
         want = None
         if self.schedule:
             want = step_based_schedule(self.schedule, st.step)
@@ -147,7 +149,21 @@ class ElasticCallback:
         Returns the (possibly re-broadcast) params on success, None when
         no recovery stage arrived within `deadline_s` or this worker was
         evicted — the caller should then fall back to fail-fast (raise /
-        exit nonzero)."""
+        exit nonzero).
+
+        Multi-death shape (a whole host SIGKILLed, several peers gone
+        at once — the `crash_host` chaos fault): the detecting runner
+        proposes one shrunken stage covering every reaped death, but a
+        survivor can race an intermediate stage that still contains a
+        dead peer, or a second death can land while the restore
+        collectives run. Both surface as KF_ERR_CONN/TIMEOUT/CORRUPT
+        *inside* the restore — the same fail-fast taxonomy that got us
+        here — so the restore failure loops back into the adopt poll
+        (bounded by the shared deadline) instead of killing the
+        survivor: every transport and topology role fails into ONE
+        recovery state machine (docs/fault_tolerance.md)."""
+        from ..ffi import KfError
+
         t0 = time.time()
         print(f"KF_MTTR error t={t0 * 1e3:.1f} rank={self.peer.rank} "
               f"epoch={self.peer.version}", flush=True)
@@ -158,32 +174,51 @@ class ElasticCallback:
         trace.event("recovery.caught", cat="recovery",
                     epoch=self.peer.version)
         trace.flight_dump(reason="recovery")
-        with trace.span("recovery.adopt", cat="recovery") as sp:
-            recovered, keep = self.peer.recover_from_url(
-                self.config_server, deadline_s=deadline_s)
-            sp.set(recovered=recovered, keep=keep)
-        if not recovered or not keep:
-            # state.keep lets the caller tell a legitimate eviction
-            # (exit 0, like the planned-resize path) from a recovery
-            # timeout (fail fast)
-            self.state.changed, self.state.keep = recovered, keep
-            print(f"KF_MTTR giveup t={time.time() * 1e3:.1f} "
-                  f"recovered={recovered} keep={keep}", flush=True)
-            return None
-        t1 = time.time()
-        print(f"KF_MTTR adopted t={t1 * 1e3:.1f} rank={self.peer.rank} "
-              f"epoch={self.peer.version} size={self.peer.size}",
-              flush=True)
-        # the recovered epoch is live: re-bind the trace context
-        # before the restore collectives emit under it
-        trace.set_context(rank=self.peer.rank,
-                          version=self.peer.version)
-        with trace.span("recovery.restore", cat="recovery",
-                        size=self.peer.size):
-            if params is not None:
-                params = self.resync_params(params)
-            else:
-                self.sync_position()
+        deadline = time.monotonic() + deadline_s
+        while True:
+            with trace.span("recovery.adopt", cat="recovery") as sp:
+                recovered, keep = self.peer.recover_from_url(
+                    self.config_server,
+                    deadline_s=max(0.0, deadline - time.monotonic()))
+                sp.set(recovered=recovered, keep=keep)
+            if not recovered or not keep:
+                # state.keep lets the caller tell a legitimate eviction
+                # (exit 0, like the planned-resize path) from a recovery
+                # timeout (fail fast)
+                self.state.changed, self.state.keep = recovered, keep
+                print(f"KF_MTTR giveup t={time.time() * 1e3:.1f} "
+                      f"recovered={recovered} keep={keep}", flush=True)
+                return None
+            t1 = time.time()
+            print(f"KF_MTTR adopted t={t1 * 1e3:.1f} "
+                  f"rank={self.peer.rank} epoch={self.peer.version} "
+                  f"size={self.peer.size}", flush=True)
+            # the recovered epoch is live: re-bind the trace context
+            # before the restore collectives emit under it
+            trace.set_context(rank=self.peer.rank,
+                              version=self.peer.version)
+            try:
+                with trace.span("recovery.restore", cat="recovery",
+                                size=self.peer.size):
+                    if params is not None:
+                        params = self.resync_params(params)
+                    else:
+                        self.sync_position()
+                break
+            except KfError as e:
+                # another peer died while the restore collectives ran
+                # (whole-host deaths arrive as a burst): fail back into
+                # the adopt poll for the next shrunken stage
+                if time.monotonic() >= deadline:
+                    self.state.changed, self.state.keep = False, True
+                    print(f"KF_MTTR giveup t={time.time() * 1e3:.1f} "
+                          f"restore-failed={e}", flush=True)
+                    return None
+                print(f"[kf-recover] restore in epoch "
+                      f"{self.peer.version} failed ({e}); re-entering "
+                      "the recovery poll", flush=True)
+                trace.event("recovery.restore_failed", cat="recovery",
+                            epoch=self.peer.version)
         t2 = time.time()
         print(f"KF_MTTR restored t={t2 * 1e3:.1f} rank={self.peer.rank} "
               f"adopt_ms={(t1 - t0) * 1e3:.1f} "

@@ -55,6 +55,17 @@ CONFIG_VARS = (
     "KF_SHM",
     "KF_HIER",
     "KF_NO_UNIX_SOCKET",
+    # shm failure semantics (docs/collectives.md "Failure semantics"):
+    # KF_SHM_REQUIRE=1 turns the per-pair socket fallback into a loud
+    # error (benchmark runs must not silently measure the wrong
+    # transport); KF_SHM_SWEEP=0 opts out of the startup sweep of
+    # stale /dev/shm/kf-u<uid> ring debris; the KF_SHM_INJECT_* pair
+    # are the deterministic chaos instruments driving the torn-frame
+    # and degraded-fallback paths in tests
+    "KF_SHM_REQUIRE",
+    "KF_SHM_SWEEP",
+    "KF_SHM_INJECT_CORRUPT",
+    "KF_SHM_INJECT_ATTACH_FAIL",
     # durable sharded checkpoints (docs/fault_tolerance.md): the
     # last rung of the recovery state machine
     "KF_CKPT_DIR",
@@ -175,6 +186,10 @@ def from_env(environ: Optional[Dict[str, str]] = None) -> Config:
     env_flag("KF_SHM", True, e)
     env_flag("KF_HIER", False, e)
     env_flag("KF_NO_UNIX_SOCKET", False, e)
+    env_flag("KF_SHM_REQUIRE", False, e)
+    env_flag("KF_SHM_SWEEP", True, e)
+    env_flag("KF_SHM_INJECT_CORRUPT", False, e)
+    env_flag("KF_SHM_INJECT_ATTACH_FAIL", False, e)
     self_spec = e.get(SELF_SPEC, "")
     if not self_spec:
         solo = PeerID.from_host("127.0.0.1", 0)

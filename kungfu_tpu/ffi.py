@@ -31,6 +31,10 @@ KF_ERR_EPOCH = -3
 KF_ERR_CONN = -4
 KF_ERR_NOTFOUND = -5
 KF_ERR_ARG = -6
+# wire-frame integrity violation (torn/corrupted shm-ring frame): the
+# channel is dead and the bytes untrusted — joins KF_ERR_CONN/TIMEOUT
+# in the fail-fast-into-recovery taxonomy (docs/fault_tolerance.md)
+KF_ERR_CORRUPT = -7
 
 _ERR_NAMES = {
     KF_ERR: "generic failure",
@@ -39,6 +43,7 @@ _ERR_NAMES = {
     KF_ERR_CONN: "connection failure",
     KF_ERR_NOTFOUND: "not found",
     KF_ERR_ARG: "invalid argument",
+    KF_ERR_CORRUPT: "wire-frame integrity violation",
 }
 
 # strategy codes: plan.topology.STRATEGY_NAMES is the one catalog
@@ -155,6 +160,7 @@ def _bind_lib() -> ctypes.CDLL:
         "kf_stats": ([P, ctypes.POINTER(ctypes.c_uint64),
                       ctypes.POINTER(ctypes.c_uint64)], None),
         "kf_link_stats": ([P, ctypes.POINTER(ctypes.c_uint64)], None),
+        "kf_shm_fallback_total": ([P], ctypes.c_uint64),
         "kf_hier": ([P], ctypes.c_int),
         "kf_version_string": ([], cs),
         "kf_accumulate": ([P, P, i64, ctypes.c_int, ctypes.c_int,
@@ -656,6 +662,15 @@ class NativePeer:
             "egress": dict(zip(LINK_CLASSES, arr[0:3])),
             "ingress": dict(zip(LINK_CLASSES, arr[3:6])),
         }
+
+    @property
+    def shm_fallbacks(self) -> int:
+        """How many per-pair shm channels degraded to the socket path
+        (attach/ENOSPC/hello failures; cumulative across epochs — a
+        pair retried and degraded again counts again). The native
+        counter behind ``kf_link_fallback_total`` on /metrics
+        (docs/collectives.md "Failure semantics")."""
+        return int(self._lib.kf_shm_fallback_total(self._h))
 
     @property
     def hierarchical(self) -> bool:

@@ -68,6 +68,11 @@ enum {
     KF_ERR_CONN = -4,     /* cannot establish connection */
     KF_ERR_NOTFOUND = -5, /* P2P request: blob absent on responder */
     KF_ERR_ARG = -6,      /* invalid argument */
+    KF_ERR_CORRUPT = -7,  /* wire-frame integrity violation (torn or
+                           * corrupted shm-ring frame): the payload is
+                           * untrusted and the channel is dead — callers
+                           * must treat it like a peer death (recover),
+                           * never deserialize the bytes */
 };
 
 /* --- lifecycle ---------------------------------------------------------- */
@@ -161,6 +166,11 @@ void kf_stats(kf_peer *, uint64_t *egress_bytes, uint64_t *ingress_bytes);
  * egress over {tcp, unix, shm}, out[3..5] = ingress over the same.
  * The kf_stats totals are always the sum of the classes. */
 void kf_link_stats(kf_peer *, uint64_t out[6]);
+/* How many per-pair shm channels degraded to the socket path this
+ * epoch-lifetime (attach/ENOSPC/hello failures; cumulative across
+ * epochs). Feeds kf_link_fallback_total on /metrics — the loud twin of
+ * KF_SHM_REQUIRE=1, which turns the degradation into an error. */
+uint64_t kf_shm_fallback_total(kf_peer *);
 /* 1 when the current session walks hierarchical (KF_HIER=1) graphs:
  * intra-host reduce -> inter-host strategy over host masters ->
  * intra-host broadcast, re-derived from the peer list on every epoch

@@ -216,6 +216,10 @@ void kf_link_stats(kf_peer *p, uint64_t out[6]) {
     }
 }
 
+uint64_t kf_shm_fallback_total(kf_peer *p) {
+    return p ? p->impl.counters.shm_fallback.load() : 0;
+}
+
 int kf_hier(kf_peer *p) {
     return with_session(
         p, [](Session *s) { return s->hierarchical() ? 1 : 0; });

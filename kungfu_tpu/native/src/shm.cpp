@@ -1,5 +1,8 @@
 #include "shm.hpp"
 
+#include "core.hpp"
+
+#include <dirent.h>
 #include <fcntl.h>
 #include <linux/futex.h>
 #include <sys/mman.h>
@@ -62,6 +65,41 @@ std::string shm_dir() {
 bool shm_transport_enabled() {
     const char *e = std::getenv("KF_SHM");
     return !(e && std::strcmp(e, "0") == 0);
+}
+
+bool shm_require() {
+    const char *e = std::getenv("KF_SHM_REQUIRE");
+    return e && std::strcmp(e, "1") == 0;
+}
+
+int shm_sweep_stale(int64_t max_age_s) {
+    const char *e = std::getenv("KF_SHM_SWEEP");
+    if (e && std::strcmp(e, "0") == 0) return 0;
+    const std::string dir = shm_dir();
+    if (dir.empty()) return 0;
+    DIR *d = ::opendir(dir.c_str());
+    if (!d) return 0;
+    int removed = 0;
+    const time_t now = ::time(nullptr);
+    while (struct dirent *ent = ::readdir(d)) {
+        const char *n = ent->d_name;
+        const size_t len = std::strlen(n);
+        if (len < 5 || std::strcmp(n + len - 5, ".ring") != 0) continue;
+        const std::string path = dir + "/" + n;
+        struct stat st{};
+        // lstat + regular-file check: never follow a planted symlink
+        if (::lstat(path.c_str(), &st) != 0 || !S_ISREG(st.st_mode))
+            continue;
+        if (now - st.st_mtime < time_t(max_age_s)) continue;  // live?
+        if (::unlink(path.c_str()) == 0) {
+            removed++;
+            KF_WARN("swept stale shm ring %s (age %llds) from a "
+                    "previous crashed run",
+                    path.c_str(), (long long)(now - st.st_mtime));
+        }
+    }
+    ::closedir(d);
+    return removed;
 }
 
 std::unique_ptr<ShmRing> ShmRing::create(const std::string &path,

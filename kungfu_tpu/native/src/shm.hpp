@@ -104,4 +104,17 @@ std::string shm_dir();
 // flip it between cluster constructions.
 bool shm_transport_enabled();
 
+// KF_SHM_REQUIRE=1 turns a would-be socket fallback for a colocated
+// pair into a loud KF_ERR instead of silent degradation (benchmark
+// runs must never quietly measure the wrong transport). Read per call.
+bool shm_require();
+
+// Remove stale ring debris under shm_dir(): a producer SIGKILLed
+// between create() and the receiver's attach-unlink leaks its file
+// (once attached, segments are anonymous and leak-free). Files older
+// than max_age_s are from dead runs — live handshakes complete in
+// milliseconds — and are unlinked at Server::start. KF_SHM_SWEEP=0
+// opts out (read per call). Returns how many files were removed.
+int shm_sweep_stale(int64_t max_age_s = 60);
+
 }  // namespace kf

@@ -17,6 +17,7 @@
 #include <thread>
 #include <vector>
 
+#include "../include/kf.h"
 #include "peer.hpp"
 
 using namespace kf;
@@ -258,6 +259,147 @@ int main() {
         return 1;
     }
     for (auto &p : hs) p->stop();
+
+    // torn-frame integrity round: arm the one-shot corruption
+    // injection, run a colocated all-reduce over the rings — the
+    // receiver must detect the header-checksum mismatch and fail with
+    // KF_ERR_CORRUPT, NEVER return a wrong sum; an epoch switch then
+    // heals the transport (fresh rings under the new token). This is
+    // the sanitize.sh coverage of the torn-frame path end to end.
+    if (shm_transport_enabled()) {
+        std::vector<PeerID> cp;
+        for (int r = 0; r < 2; r++) {
+            PeerID p;
+            p.ipv4 = (127u << 24) | 1u;
+            p.port = uint16_t(base_port() + 12 + r);
+            cp.push_back(p);
+        }
+        std::vector<std::unique_ptr<Peer>> cs;
+        for (int r = 0; r < 2; r++)
+            cs.push_back(std::make_unique<Peer>(cp[r], cp, 0,
+                                                Strategy::star, 4000));
+        for (auto &p : cs)
+            if (p->start() != 0) {
+                std::fprintf(stderr, "corrupt-round start failed\n");
+                return 1;
+            }
+        ::setenv("KF_SHM_INJECT_CORRUPT", "1", 1);
+        int rcs[2] = {0, 0};
+        double outs[2] = {0, 0};
+        {
+            std::vector<std::thread> ts;
+            for (int r = 0; r < 2; r++)
+                ts.emplace_back([&, r] {
+                    std::vector<double> b(63, double(r + 1)), o(63);
+                    std::shared_lock<std::shared_mutex> lk(
+                        cs[r]->session_mu());
+                    rcs[r] = cs[r]->session()->all_reduce(
+                        b.data(), o.data(), 63, Dtype::f64, ROp::sum,
+                        "corrupt");
+                    outs[r] = o[0];
+                });
+            for (auto &t : ts) t.join();
+        }
+        ::unsetenv("KF_SHM_INJECT_CORRUPT");
+        // rank 0 (STAR root) receives the corrupted reduce frame and
+        // must see the integrity failure as itself; nobody may hold a
+        // wrong sum
+        if (rcs[0] != KF_ERR_CORRUPT) {
+            std::fprintf(stderr,
+                         "corrupt frame not detected: rc0=%d rc1=%d\n",
+                         rcs[0], rcs[1]);
+            return 1;
+        }
+        for (int r = 0; r < 2; r++)
+            if (rcs[r] == 0 && outs[r] != 3.0) {
+                std::fprintf(stderr, "corrupt frame fed a wrong sum: "
+                                     "rank %d out=%f\n",
+                             r, outs[r]);
+                return 1;
+            }
+        // epoch switch re-establishes clean rings: sums exact again
+        for (int r = 0; r < 2; r++)
+            if (cs[r]->update(cp, 1) != 0) {
+                std::fprintf(stderr, "corrupt-round update failed\n");
+                return 1;
+            }
+        {
+            std::vector<std::thread> ts;
+            for (int r = 0; r < 2; r++)
+                ts.emplace_back([&, r] {
+                    std::vector<double> b(63, double(r + 1)), o(63);
+                    std::shared_lock<std::shared_mutex> lk(
+                        cs[r]->session_mu());
+                    int rc = cs[r]->session()->all_reduce(
+                        b.data(), o.data(), 63, Dtype::f64, ROp::sum,
+                        "healed");
+                    if (rc != 0 || o[0] != 3.0) failures++;
+                });
+            for (auto &t : ts) t.join();
+        }
+        if (failures) {
+            std::fprintf(stderr, "post-corruption epoch did not heal\n");
+            return 1;
+        }
+        for (auto &p : cs) p->stop();
+    }
+
+    // degraded-transport round: the receiver refuses to map rings
+    // (the deterministic /dev/shm-ENOSPC stand-in); the pair must fall
+    // back to sockets pre-payload (sums stay exact), the fallback must
+    // be COUNTED, and no byte may claim the shm link class.
+    if (shm_transport_enabled()) {
+        ::setenv("KF_SHM_INJECT_ATTACH_FAIL", "1", 1);
+        std::vector<PeerID> fp;
+        for (int r = 0; r < 2; r++) {
+            PeerID p;
+            p.ipv4 = (127u << 24) | 1u;
+            p.port = uint16_t(base_port() + 14 + r);
+            fp.push_back(p);
+        }
+        std::vector<std::unique_ptr<Peer>> fs;
+        for (int r = 0; r < 2; r++)
+            fs.push_back(std::make_unique<Peer>(fp[r], fp, 0,
+                                                Strategy::star, 20000));
+        for (auto &p : fs)
+            if (p->start() != 0) {
+                std::fprintf(stderr, "fallback-round start failed\n");
+                return 1;
+            }
+        {
+            std::vector<std::thread> ts;
+            for (int r = 0; r < 2; r++)
+                ts.emplace_back([&, r] {
+                    std::vector<float> b(501, float(r + 1)), o(501);
+                    std::shared_lock<std::shared_mutex> lk(
+                        fs[r]->session_mu());
+                    int rc = fs[r]->session()->all_reduce(
+                        b.data(), o.data(), 501, Dtype::f32, ROp::sum,
+                        "fb");
+                    if (rc != 0 || o[500] != 3.0f) failures++;
+                });
+            for (auto &t : ts) t.join();
+        }
+        ::unsetenv("KF_SHM_INJECT_ATTACH_FAIL");
+        if (failures) {
+            std::fprintf(stderr, "degraded fallback broke the sum\n");
+            return 1;
+        }
+        uint64_t fallbacks = 0, shm_eg = 0;
+        for (auto &p : fs) {
+            fallbacks += p->counters.shm_fallback.load();
+            shm_eg += p->counters.egress_link[int(LinkClass::shm)].load();
+        }
+        if (fallbacks == 0 || shm_eg != 0) {
+            std::fprintf(stderr,
+                         "fallback not counted (%llu) or shm bytes "
+                         "leaked (%llu)\n",
+                         (unsigned long long)fallbacks,
+                         (unsigned long long)shm_eg);
+            return 1;
+        }
+        for (auto &p : fs) p->stop();
+    }
     std::printf("smoke ok\n");
     return 0;
 }

@@ -74,6 +74,49 @@ void grow_unix_bufs(int fd) {
 // bigger messages stream through in pieces as the reader drains.
 constexpr uint32_t kShmRingBytes = 4u << 20;
 
+// Per-frame header checksum for the shm rings (FNV-1a 32-bit over the
+// serialized name_len/name/flags/len fields). Sockets get framing
+// integrity from the kernel's stream discipline; a mmap'd ring has
+// none, and a torn or corrupted FRAME HEADER — a mid-frame SIGKILL,
+// a stray write into the header bytes — would otherwise make the
+// reader deserialize garbage name/length fields and feed a mis-framed
+// payload into a reduce. Header corruption surfaces as
+// KF_ERR_CORRUPT; a torn PAYLOAD can only stall (missing bytes),
+// which the liveness deadline catches as KF_ERR_CONN — it is never
+// mis-framed. Payload BYTE corruption inside the mapped region is
+// out of scope of this cheap check, the same exposure any in-RAM
+// buffer has on every transport (docs/collectives.md "Failure
+// semantics").
+uint32_t frame_crc32(const uint8_t *data, size_t n) {
+    uint32_t h = 2166136261u;
+    for (size_t i = 0; i < n; i++) {
+        h ^= data[i];
+        h *= 16777619u;
+    }
+    return h;
+}
+
+// KF_SHM_INJECT_CORRUPT=1: seeded-chaos hook — corrupt the checksum of
+// the NEXT shm frame this process sends (one-shot latch), so tests and
+// the sanitizer smoke can drive the torn-frame detection path
+// deterministically end to end. Read per send until it fires, so an
+// in-process test can arm it after other clusters already ran.
+bool take_corrupt_injection() {
+    static std::atomic<bool> fired{false};
+    if (fired.load(std::memory_order_relaxed)) return false;
+    const char *e = std::getenv("KF_SHM_INJECT_CORRUPT");
+    if (!e || std::strcmp(e, "1") != 0) return false;
+    return !fired.exchange(true);
+}
+
+// KF_SHM_INJECT_ATTACH_FAIL=1: receiver refuses to map offered rings
+// (acks 0), driving the real degraded-transport fallback path — the
+// deterministic stand-in for /dev/shm ENOSPC or mount policy.
+bool inject_attach_fail() {
+    const char *e = std::getenv("KF_SHM_INJECT_ATTACH_FAIL");
+    return e && std::strcmp(e, "1") == 0;
+}
+
 // After the hello exchange the shm socket is silent, so any readability
 // (EOF, reset) means the sender is gone or fenced out.
 bool shm_sock_dead(int fd) {
@@ -368,8 +411,11 @@ int Rendezvous::pop_into(const PeerID &src, const std::string &name,
             BufferPool::instance().put(std::move(msg));
             return KF_OK;
         }
-        // nothing queued and the sender's conn died mid-epoch: this
-        // receive can never be satisfied
+        // nothing queued and the sender's channel rotted (corrupt
+        // frame) or died mid-epoch: this receive can never be
+        // satisfied — corrupt outranks dead so the distinct failure
+        // class stays visible through the recovery path
+        if (corrupt_.count(src.str())) return KF_ERR_CORRUPT;
         if (dead_.count(src.str())) return KF_ERR_CONN;
         slots_[key].push_back(&slot);
         registered = true;
@@ -379,7 +425,9 @@ int Rendezvous::pop_into(const PeerID &src, const std::string &name,
             if (len) *len = slot.len;
             return KF_OK;
         }
-        if (slot.state == RecvSlot::failed) return KF_ERR_CONN;
+        if (slot.state == RecvSlot::failed)
+            return corrupt_.count(src.str()) ? KF_ERR_CORRUPT
+                                             : KF_ERR_CONN;
         const auto now = std::chrono::steady_clock::now();
         // a claimed slot is being written by the reader thread: the buffer
         // is in use, so the timeout must wait for the commit
@@ -418,13 +466,46 @@ int Rendezvous::pop_into(const PeerID &src, const std::string &name,
 void Rendezvous::conn_opened(const PeerID &src) {
     std::lock_guard<std::mutex> lk(mu_);
     live_conns_[src.str()]++;
-    // the peer is demonstrably alive (again): lift any death mark
+    // the peer is demonstrably alive (again): lift any death mark;
+    // a fresh channel also supersedes a corrupt one (the rotten ring
+    // was torn down with its connection)
     dead_.erase(src.str());
+    corrupt_.erase(src.str());
+}
+
+// Fail every waiting slot registered against peer `key` and wake the
+// blocked receivers (caller holds mu_; CONN-vs-CORRUPT is decided by
+// the dead_/corrupt_ marks alone).
+static void fail_waiting_slots_locked(
+    std::unordered_map<std::string, std::deque<Rendezvous::RecvSlot *>>
+        &slots,
+    const std::string &key) {
+    const std::string prefix = key + "|";
+    for (auto sit = slots.begin(); sit != slots.end();) {
+        if (sit->first.compare(0, prefix.size(), prefix) != 0) {
+            ++sit;
+            continue;
+        }
+        for (Rendezvous::RecvSlot *s : sit->second)
+            if (s->state == Rendezvous::RecvSlot::waiting)
+                s->state = Rendezvous::RecvSlot::failed;
+        sit = slots.erase(sit);
+    }
+}
+
+void Rendezvous::conn_corrupt(const PeerID &src) {
+    const std::string key = src.str();
+    std::lock_guard<std::mutex> lk(mu_);
+    corrupt_.insert(key);
+    // the mark alone decides CONN-vs-CORRUPT in pop_into; the failure
+    // mechanics are identical to a peer death: fail every waiting slot
+    // registered against this peer so blocked receivers return NOW
+    fail_waiting_slots_locked(slots_, key);
+    cv_.notify_all();
 }
 
 void Rendezvous::conn_lost(const PeerID &src, bool may_fail) {
     const std::string key = src.str();
-    const std::string prefix = key + "|";
     std::lock_guard<std::mutex> lk(mu_);
     auto it = live_conns_.find(key);
     if (it != live_conns_.end()) {
@@ -433,15 +514,7 @@ void Rendezvous::conn_lost(const PeerID &src, bool may_fail) {
     }
     if (!may_fail) return;  // epoch-switch close or server shutdown
     dead_.insert(key);
-    for (auto sit = slots_.begin(); sit != slots_.end();) {
-        if (sit->first.compare(0, prefix.size(), prefix) != 0) {
-            ++sit;
-            continue;
-        }
-        for (RecvSlot *s : sit->second)
-            if (s->state == RecvSlot::waiting) s->state = RecvSlot::failed;
-        sit = slots_.erase(sit);
-    }
+    fail_waiting_slots_locked(slots_, key);
     cv_.notify_all();
 }
 
@@ -449,6 +522,7 @@ void Rendezvous::clear() {
     std::lock_guard<std::mutex> lk(mu_);
     q_.clear();
     dead_.clear();
+    corrupt_.clear();  // the rotten channel dies with its epoch
     // fail every waiting registration so blocked receivers fail fast at an
     // epoch switch instead of timing out; claimed slots are mid-write and
     // resolve via the reader's commit_recv
@@ -665,7 +739,31 @@ int Client::send_shm(const PeerID &dest, const std::string &name,
                      uint32_t flags, const void *data, size_t len) {
     auto ch = get_shm(dest);
     std::lock_guard<std::mutex> lk(ch->mu);
-    if (ch->failed) return kShmFallback;
+    // Degraded-transport mode is FIRST-CLASS, never silent: the pair is
+    // counted (kf_link_fallback_total), logged once (failed latches for
+    // the epoch; Client::reset clears the channel map, so the next
+    // epoch switch retries shm), and KF_SHM_REQUIRE=1 turns the
+    // degradation into a loud error for benchmark runs that must not
+    // quietly measure the socket path.
+    auto degrade = [&](const char *why) -> int {
+        ch->failed = true;
+        if (shm_require()) {
+            // no fallback happens in require mode, so the fallback
+            // counter stays untouched: kf_link_fallback_total must
+            // mean "bytes moved to sockets", never "failed loudly"
+            KF_ERROR("KF_SHM_REQUIRE=1 but shm to %s is unavailable "
+                     "(%s): failing instead of degrading to sockets",
+                     dest.str().c_str(), why);
+            return KF_ERR;
+        }
+        counters_->shm_fallback.fetch_add(1);
+        KF_WARN("shm to %s unavailable (%s): pair degraded to socket "
+                "transport for this epoch (kf_link_fallback_total++; "
+                "retried at the next epoch switch)",
+                dest.str().c_str(), why);
+        return kShmFallback;
+    };
+    if (ch->failed) return shm_require() ? KF_ERR : kShmFallback;
     // the hello socket is the receiver's liveness/epoch signal: its
     // EOF means the ring reader is gone (peer died, or its epoch
     // switch kicked us), so writing would "succeed" into a ring
@@ -679,10 +777,7 @@ int Client::send_shm(const PeerID &dest, const std::string &name,
     }
     if (!ch->ring) {
         const std::string dir = shm_dir();
-        if (dir.empty()) {
-            ch->failed = true;
-            return kShmFallback;
-        }
+        if (dir.empty()) return degrade("no usable /dev/shm directory");
         // dial with the same patience budgets sockets get: full
         // patience for a dest that may still be booting, the short
         // reconnect budget once this channel was established and lost
@@ -706,8 +801,7 @@ int Client::send_shm(const PeerID &dest, const std::string &name,
         if (fd < 0) {
             if (fd == KF_ERR_EPOCH) return fd;
             if (ch->was_connected) return KF_ERR_CONN;  // died mid-epoch
-            ch->failed = true;
-            return kShmFallback;
+            return degrade("hello dial exhausted its patience budget");
         }
         char path[192];
         std::snprintf(path, sizeof(path), "%s/%08x-%u-%08x-%u-%u-%u.ring",
@@ -724,27 +818,36 @@ int Client::send_shm(const PeerID &dest, const std::string &name,
             !read_exact(fd, &ack, 1) || ack != 1) {
             ::close(fd);
             if (ring) ring->unlink();
-            ch->failed = true;
-            return kShmFallback;
+            return degrade(!ring ? "ring segment creation failed "
+                                   "(/dev/shm full?)"
+                                 : "receiver could not map the ring");
         }
         ch->fd = fd;
         ch->abort.store(false);
         ch->ring = std::move(ring);
         ch->was_connected = true;
     }
-    // framed exactly like write_message, streamed into the ring; the
-    // payload goes source buffer -> ring with no staging vector
-    uint8_t hdr[12 + 4096];
+    // framed like write_message plus a leading u32 header checksum,
+    // streamed into the ring; the payload goes source buffer -> ring
+    // with no staging vector
+    uint8_t hdr[16 + 4096];
     const uint32_t name_len = uint32_t(name.size());
     if (name_len > 4096) return KF_ERR_ARG;
-    std::memcpy(hdr, &name_len, 4);
-    std::memcpy(hdr + 4, name.data(), name_len);
+    std::memcpy(hdr + 4, &name_len, 4);
+    std::memcpy(hdr + 8, name.data(), name_len);
     const uint32_t len32 = uint32_t(len);
-    std::memcpy(hdr + 4 + name_len, &flags, 4);
-    std::memcpy(hdr + 8 + name_len, &len32, 4);
+    std::memcpy(hdr + 8 + name_len, &flags, 4);
+    std::memcpy(hdr + 12 + name_len, &len32, 4);
+    uint32_t crc = frame_crc32(hdr + 4, 12 + name_len);
+    if (take_corrupt_injection()) {
+        KF_WARN("KF_SHM_INJECT_CORRUPT: corrupting frame %s -> %s",
+                name.c_str(), dest.str().c_str());
+        crc ^= 0xDEADBEEFu;
+    }
+    std::memcpy(hdr, &crc, 4);
     const int64_t stall = body_stall_ms();
     auto alive = [&ch] { return !ch->abort.load(); };
-    if (!ch->ring->write(hdr, 12 + name_len, stall, alive) ||
+    if (!ch->ring->write(hdr, 16 + name_len, stall, alive) ||
         (len && !ch->ring->write(data, len, stall, alive))) {
         // receiver dead or torn down mid-epoch: fail like a lost
         // collective conn (no silent socket fallback — per-pair order
@@ -841,6 +944,12 @@ void Client::reset(const std::vector<PeerID> &keep, uint32_t token) {
 // ----------------------------------------------------------------- server
 
 int Server::start() {
+    // startup hygiene: unlink ring debris from previous crashed runs
+    // (a producer SIGKILLed inside the create->attach handshake window
+    // leaks its file; attached segments never do). Age-gated so a
+    // concurrent cluster's in-flight handshake is untouched;
+    // KF_SHM_SWEEP=0 opts out (docs/collectives.md).
+    if (shm_transport_enabled()) shm_sweep_stale();
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return KF_ERR;
     int one = 1;
@@ -1115,7 +1224,8 @@ void Server::serve_shm(int fd, const PeerID &src, bool same_epoch,
     // hello: exactly one message whose name is the sender's ring path
     WireMessage hello;
     if (!read_message(fd, &hello, 4096)) return;
-    auto ring = ShmRing::attach(hello.name);
+    auto ring = inject_attach_fail() ? nullptr
+                                     : ShmRing::attach(hello.name);
     uint8_t ok = ring ? 1 : 0;
     if (ring) ring->unlink();  // both sides mapped: the name can go
     if (!write_exact(fd, &ok, 1) || !ring) return;
@@ -1126,6 +1236,12 @@ void Server::serve_shm(int fd, const PeerID &src, bool same_epoch,
     // like any live fd), polled between messages and inside body waits
     auto alive = [this, fd] { return running_ && !shm_sock_dead(fd); };
     const int64_t stall = body_stall_ms();
+    // integrity: a frame whose header fails its checksum or length
+    // validation poisons the WHOLE channel (the stream position is
+    // untrusted from that byte on) — receivers blocked on this peer
+    // fail with KF_ERR_CORRUPT and ride the same recovery path a peer
+    // death does, instead of a garbage name/len feeding a reduce
+    bool corrupt = false;
     while (running_) {
         const int r = ring->wait_readable(100);
         if (r < 0) break;  // producer closed (clean teardown)
@@ -1135,15 +1251,34 @@ void Server::serve_shm(int fd, const PeerID &src, bool same_epoch,
         }
         // a message has begun: the rest of its frame streams out under
         // the same mid-body stall contract sockets get
-        uint32_t name_len;
+        uint32_t crc, name_len;
+        if (!ring->read(&crc, 4, stall, alive)) break;
         if (!ring->read(&name_len, 4, stall, alive)) break;
-        if (name_len > 4096) break;
-        std::string name(name_len, '\0');
-        if (name_len && !ring->read(name.data(), name_len, stall, alive))
+        if (name_len > 4096) {
+            KF_ERROR("shm ring from %s: frame name_len %u fails "
+                     "validation — torn/corrupt frame, failing the "
+                     "channel (KF_ERR_CORRUPT)",
+                     src.str().c_str(), name_len);
+            corrupt = true;
             break;
+        }
+        uint8_t hdr[12 + 4096];
+        std::memcpy(hdr, &name_len, 4);
+        if (name_len && !ring->read(hdr + 4, name_len, stall, alive))
+            break;
+        if (!ring->read(hdr + 4 + name_len, 8, stall, alive)) break;
+        if (frame_crc32(hdr, 12 + name_len) != crc) {
+            KF_ERROR("shm ring from %s: frame header checksum mismatch "
+                     "— torn/corrupt frame, failing the channel "
+                     "(KF_ERR_CORRUPT)",
+                     src.str().c_str());
+            corrupt = true;
+            break;
+        }
+        std::string name(reinterpret_cast<char *>(hdr) + 4, name_len);
         uint32_t flags, len;
-        if (!ring->read(&flags, 4, stall, alive)) break;
-        if (!ring->read(&len, 4, stall, alive)) break;
+        std::memcpy(&flags, hdr + 4 + name_len, 4);
+        std::memcpy(&len, hdr + 8 + name_len, 4);
         counters_->add_ingress(LinkClass::shm, len);
         if (auto *slot = rdv_->begin_recv(src, name, len)) {
             // registered receive: ring bytes land straight in the
@@ -1161,6 +1296,13 @@ void Server::serve_shm(int fd, const PeerID &src, bool same_epoch,
         if (len && !ring->read(msg.data.data(), len, stall, alive)) break;
         rdv_->push(src, std::move(msg));
     }
+    // the corrupt mark carries the SAME live-token guard conn_lost
+    // gets: a stale reader (epoch already switched, clear() already
+    // wiped the marks) finishing its detection late must not poison
+    // the new epoch's corrupt_ set
+    if (corrupt && same_epoch && running_ &&
+        token_.load() == epoch_token)
+        rdv_->conn_corrupt(src);
     if (same_epoch)
         rdv_->conn_lost(src, running_ && token_.load() == epoch_token);
 }

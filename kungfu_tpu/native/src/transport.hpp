@@ -37,7 +37,12 @@ enum class ConnType : uint16_t {
     // shm hello/liveness channel: the dial carries the normal epoch
     // token handshake, then exactly one message naming the sender's
     // ring segment; afterwards the socket is silent and its EOF is the
-    // (only) death/epoch-reset signal for the ring reader
+    // (only) death/epoch-reset signal for the ring reader. Ring frames
+    // prepend a u32 FNV-1a checksum of the frame header (name_len,
+    // name, flags, len) to the socket frame format, so a torn or
+    // header-corrupted frame surfaces as KF_ERR_CORRUPT instead of
+    // being mis-framed into a reduce (docs/collectives.md "Failure
+    // semantics").
     shm = 4,
 };
 
@@ -155,6 +160,15 @@ class Rendezvous {
     // the newer conn is open, and a fresh conn lifts any death mark.
     void conn_opened(const PeerID &src);
     void conn_lost(const PeerID &src, bool may_fail);
+    // Frame-integrity violation on an inbound channel (shm ring frame
+    // failed its header checksum / length validation): the stream
+    // position is untrusted, so the whole channel dies and receivers
+    // blocked on this peer fail with KF_ERR_CORRUPT — the same
+    // fail-fast-into-recovery shape as a peer death, but with a
+    // distinct code so a silent-garbage bug class is visible as
+    // itself. Lifted like a death mark: clear() (epoch switch) or a
+    // fresh conn from the peer.
+    void conn_corrupt(const PeerID &src);
 
   private:
     std::mutex mu_;
@@ -162,6 +176,9 @@ class Rendezvous {
     std::unordered_map<std::string, std::deque<std::vector<uint8_t>>> q_;
     std::unordered_map<std::string, std::deque<RecvSlot *>> slots_;
     std::unordered_set<std::string> dead_;  // peers whose conn died mid-epoch
+    // peers whose inbound frames failed integrity checks: receives
+    // fail with KF_ERR_CORRUPT instead of KF_ERR_CONN
+    std::unordered_set<std::string> corrupt_;
     std::unordered_map<std::string, int> live_conns_;  // inbound, per peer
 };
 
@@ -204,6 +221,10 @@ struct Counters {
     // above stay the sum so existing consumers keep their meaning
     std::atomic<uint64_t> egress_link[kNumLinkClasses]{{0}, {0}, {0}};
     std::atomic<uint64_t> ingress_link[kNumLinkClasses]{{0}, {0}, {0}};
+    // per-pair shm establishment failures that degraded to sockets
+    // (kf_link_fallback_total): the degraded-transport mode is counted
+    // and logged, never silent (docs/collectives.md)
+    std::atomic<uint64_t> shm_fallback{0};
 
     void add_egress(LinkClass lc, uint64_t n) {
         egress += n;

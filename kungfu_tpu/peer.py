@@ -180,6 +180,19 @@ class Peer:
     def workers(self) -> PeerList:
         return self._workers
 
+    @property
+    def host_index(self) -> int:
+        """Index of this worker's host among the CURRENT membership's
+        distinct hosts, in first-seen rank order — the coordinate the
+        ``crash_host`` chaos fault matches on (every rank derives the
+        same host numbering from its replica of the PeerList, so a
+        host-scoped fault fires on exactly the colocated set)."""
+        hosts = self._workers.hosts()
+        try:
+            return hosts.index(self.config.self_id.ipv4)
+        except ValueError:
+            return 0  # single-process / not in list: degenerate host 0
+
     # -- collectives / store (control plane) --------------------------------
 
     def barrier(self):
@@ -263,11 +276,18 @@ class Peer:
         return (self._native is not None
                 and self._native.hierarchical)
 
+    @property
+    def shm_fallbacks(self) -> int:
+        """Per-pair shm→socket degradations (docs/collectives.md)."""
+        return 0 if self._native is None else self._native.shm_fallbacks
+
     def publish_link_metrics(self) -> None:
-        """Incrementally publish kf_wire_bytes_total{link=...} from
-        the native per-link-class egress counters. Called by the data
-        paths (gradient pipeline, streaming resync) after their wire
-        work so /metrics attributes traffic to {tcp, unix, shm}."""
+        """Incrementally publish kf_wire_bytes_total{link=...} and
+        kf_link_fallback_total from the native per-link-class counters.
+        Called by the data paths (gradient pipeline, streaming resync)
+        after their wire work so /metrics attributes traffic to
+        {tcp, unix, shm} — and makes the degraded-transport mode
+        visible on /metrics, not just in logs."""
         from .trace import metrics
 
         egress = self.link_stats()["egress"]
@@ -278,6 +298,11 @@ class Peer:
                 metrics.REGISTRY.inc("kf_wire_bytes_total", delta,
                                      link=cls)
         self._last_link_egress = egress
+        fallbacks = self.shm_fallbacks
+        delta = fallbacks - getattr(self, "_last_shm_fallbacks", 0)
+        if delta > 0:
+            metrics.REGISTRY.inc("kf_link_fallback_total", delta)
+        self._last_shm_fallbacks = fallbacks
 
     def latencies(self):
         """RTT (us) to every peer; 0 for self. (reference:

@@ -123,7 +123,11 @@ def _worker_env_delta(
 
 
 def _attach_pump(popen, rank, log_path: str, quiet: bool) -> Proc:
-    log_file = open(log_path, "wb")
+    # append, never truncate: a replacement joiner after a recovery
+    # reuses its predecessor's (rank, port) — and the predecessor's
+    # log holds its crash record (KF_CHAOS_FIRE, flight-dump notices),
+    # exactly the bytes a post-mortem (and the MTTR harness) needs
+    log_file = open(log_path, "ab")
     color = _COLORS[(rank if rank is not None else 0) % len(_COLORS)]
     pump = threading.Thread(
         target=_pump,

@@ -11,11 +11,13 @@ reference's fail-fast-and-respawn-from-survivors model (SURVEY §5.3).
 
 With recovery enabled (`-recover` / KF_RECOVER=1) the runner instead
 becomes the failure DETECTOR of a survivor-driven recovery loop: it
-proposes a shrunken PeerList (current stage minus the dead worker) to
-the config server, and the surviving workers — whose collectives failed
-fast with KF_ERR_CONN — poll for that stage and adopt it without the
-dead peer's vote (`Peer.recover_from_url`), restore state over the live
-resync path, and keep training. The proposal budget (`KF_RECOVERY_BUDGET`)
+proposes a shrunken PeerList (current stage minus every dead worker
+reaped in the same supervision pass — a whole-host SIGKILL arrives as
+a burst and must become ONE proposal, never intermediate stages still
+containing a corpse) to the config server, and the surviving workers —
+whose collectives failed fast with KF_ERR_CONN — poll for that stage
+and adopt it without the dead peers' votes (`Peer.recover_from_url`),
+restore state over the live resync path, and keep training. The proposal budget (`KF_RECOVERY_BUDGET`)
 bounds how many times this may happen before the runner falls back to
 fail-fast; every phase emits a KF_MTTR marker so
 `benchmarks/recovery.py` can decompose detect/consensus/restore.
@@ -124,6 +126,14 @@ class Watcher:
         # fallback base when the config server answers 404 (restarted
         # empty, or the boot-time seed lost its race)
         self.last_stage: Optional[Stage] = None
+        # set when a crash burst emptied this host under recovery: the
+        # schedule/policy is about to re-grow onto it, so the runner
+        # must LINGER instead of exiting at 0 local workers (a
+        # whole-host death would otherwise leave nobody to spawn the
+        # replacement joiners and wedge the survivors' join barrier) —
+        # bounded, so a run that finishes at the shrunken size still
+        # terminates
+        self.regrow_deadline: Optional[float] = None
         self.expected_exits: set = set()
         self.stages: "queue.Queue[Optional[Stage]]" = queue.Queue()
         self.seen_versions: set = set()
@@ -198,6 +208,8 @@ class Watcher:
                                     stage.cluster.workers, stage.version,
                                     **kwargs)
             self.procs[peer] = proc
+        if self.procs:
+            self.regrow_deadline = None  # host repopulated
         print(
             f"[kfrun] epoch {stage.version}: {len(self.procs)} local "
             f"worker(s) of {len(stage.cluster.workers)}",
@@ -206,7 +218,12 @@ class Watcher:
 
     def _check_procs(self) -> Optional[int]:
         """Reap exits. Crash (unexpected nonzero) => recover (when
-        enabled and within budget) or fail fast."""
+        enabled and within budget) or fail fast. ALL deaths reaped in
+        one pass are proposed as ONE shrink: a whole emulated host
+        SIGKILLed (the crash_host chaos fault) reaps as a burst, and
+        publishing intermediate stages that still contain a dead peer
+        would race survivors into join barriers no one can complete."""
+        crashed = []
         for peer, proc in list(self.procs.items()):
             code = proc.popen.poll()
             if code is None:
@@ -217,23 +234,28 @@ class Watcher:
             expected = peer in self.expected_exits
             self.expected_exits.discard(peer)
             if code != 0 and not expected:
-                if self._propose_shrink(peer, proc, code):
-                    continue
-                print(
-                    f"[kfrun] worker rank {proc.rank} crashed with {code}; "
-                    "failing fast",
-                    flush=True,
-                )
-                return code
-        return None
+                crashed.append((peer, proc, code))
+        if not crashed:
+            return None
+        if self._propose_shrink(crashed):
+            return None
+        for peer, proc, code in crashed:
+            print(
+                f"[kfrun] worker rank {proc.rank} crashed with {code}; "
+                "failing fast",
+                flush=True,
+            )
+        return crashed[0][2]
 
-    def _propose_shrink(self, dead: PeerID, proc: Proc, code: int) -> bool:
-        """Survivor-driven recovery, detection side: publish a shrunken
-        stage (minus the dead worker) to the config server. The
-        survivors — blocked on KF_ERR_CONN — poll for it and adopt it
-        without the dead peer's vote (Peer.recover_from_url). Returns
-        False when recovery is off/over budget/impossible, which sends
-        the caller down today's fail-fast path."""
+    def _propose_shrink(self, crashed) -> bool:
+        """Survivor-driven recovery, detection side: publish ONE
+        shrunken stage (minus every dead worker in `crashed`) to the
+        config server. The survivors — blocked on KF_ERR_CONN — poll
+        for it and adopt it without the dead peers' votes
+        (Peer.recover_from_url). A multi-death burst counts as one
+        recovery against the budget. Returns False when recovery is
+        off/over budget/impossible, which sends the caller down
+        today's fail-fast path."""
         if not self.recover:
             return False
         if self.recoveries >= self.recovery_budget:
@@ -244,13 +266,15 @@ class Watcher:
             )
             return False
         t_detect = time.time()
-        print(
-            f"KF_MTTR detect t={t_detect * 1e3:.1f} rank={proc.rank} "
-            f"peer={dead} code={code}",
-            flush=True,
-        )
-        trace.event("recovery.detect", cat="recovery",
-                    dead_rank=proc.rank, code=code)
+        dead_set = [peer for peer, _proc, _code in crashed]
+        for peer, proc, code in crashed:
+            print(
+                f"KF_MTTR detect t={t_detect * 1e3:.1f} rank={proc.rank} "
+                f"peer={peer} code={code}",
+                flush=True,
+            )
+            trace.event("recovery.detect", cat="recovery",
+                        dead_rank=proc.rank, code=code)
         # The runner's whole propose window must END before the
         # survivors' recovery polls give up (KF_RECOVERY_DEADLINE_MS,
         # default 30 s) — a proposal landing after the survivors exited
@@ -292,18 +316,21 @@ class Watcher:
                 )
                 stage = self.last_stage
             workers = stage.cluster.workers
-            if workers.rank(dead) is None:
-                # already removed (another runner / an earlier proposal
-                # covering this death): survivors will adopt that stage.
-                # Nothing was proposed HERE, so neither the budget nor
-                # the KF_MTTR proposed marker applies
+            if all(workers.rank(d) is None for d in dead_set):
+                # already removed (another proposal / a planned resize
+                # covering these deaths): survivors will adopt that
+                # stage. Nothing was proposed HERE, so neither the
+                # budget nor the KF_MTTR proposed marker applies — but
+                # an emptied host must STILL linger for the re-grow
+                # (the wedge does not care who published the shrink)
                 print(
-                    f"[kfrun] recovery: {dead} already absent from "
+                    f"[kfrun] recovery: {dead_set} already absent from "
                     f"stage v{stage.version}; survivors adopt that",
                     flush=True,
                 )
+                self._arm_regrow_linger()
                 return True
-            remaining = PeerList(w for w in workers if w != dead)
+            remaining = PeerList(w for w in workers if w not in dead_set)
             if not remaining:
                 print("[kfrun] recovery: no survivors to shrink to",
                       flush=True)
@@ -337,7 +364,26 @@ class Watcher:
         trace.event("recovery.propose", cat="recovery",
                     stage_version=shrunken.version,
                     survivors=len(self.procs))
+        self._arm_regrow_linger()
         return True
+
+    def _arm_regrow_linger(self) -> None:
+        """A recovery that emptied this host (whole-host death): the
+        schedule observes size < target at the survivors' next step
+        and re-grows ONTO this host — stay alive to spawn the
+        replacement joiners, bounded by twice the survivors' recovery
+        deadline so a run that ends shrunken still terminates."""
+        if self.procs:
+            return
+        worker_deadline_s = float(
+            os.environ.get("KF_RECOVERY_DEADLINE_MS", "30000")) / 1e3
+        linger_s = 2 * worker_deadline_s
+        self.regrow_deadline = time.monotonic() + linger_s
+        print(
+            f"[kfrun] recovery emptied this host; lingering up to "
+            f"{linger_s:.0f}s for the schedule's re-grow",
+            flush=True,
+        )
 
     def run(self, initial: Optional[Stage]) -> int:
         self.control.start()
@@ -363,6 +409,11 @@ class Watcher:
                 if not self.procs and not self.keep \
                         and self.current_version >= 0 \
                         and self.stages.empty():
+                    if self.regrow_deadline is not None:
+                        if time.monotonic() < self.regrow_deadline:
+                            continue  # awaiting the post-crash re-grow
+                        print("[kfrun] no re-grow arrived within the "
+                              "linger window; exiting", flush=True)
                     break
             self._shutdown()
             return 0

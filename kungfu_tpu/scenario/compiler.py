@@ -24,6 +24,13 @@ Lowering rules:
 - ``preempt`` with a pinned rank -> a ``crash_worker`` fault plus
   ``KF_RECOVER=1`` (survivor recovery adopts the shrink; the schedule
   then re-grows to target through the ordinary elastic path).
+- ``preempt`` with a pinned host -> a ``crash_host`` fault plus
+  ``KF_RECOVER=1``: every rank on the emulated host dies at the step
+  (whole-host spot reclamation), the host's runner proposes one
+  shrunken stage for the burst, and the cross-host survivors recover.
+  The scenario's ``hosts`` layout lowers to the loopback-alias host
+  spec (``127.0.0.1:a,127.0.0.2:b``) the multi-runner replay launches
+  with.
 - ``preempt`` with cluster scope -> a **phase boundary**: the phase
   ends with an unpinned ``crash_worker`` fault (every process dies =
   the allocation was reclaimed; expected exit is nonzero) and the next
@@ -75,6 +82,9 @@ class ScenarioPlan:
     needs_ckpt: bool = False
     description: str = ""
     notes: Tuple[str, ...] = field(default_factory=tuple)
+    # multi-host replays: "127.0.0.1:a,127.0.0.2:b" (one kfrun per
+    # listed ip at replay time); "" = the single-runner launch
+    hosts: str = ""
 
 
 def _size_timeline(scenario: Scenario) -> List[Tuple[int, int]]:
@@ -105,6 +115,16 @@ def _schedule_string(scenario: Scenario) -> str:
         if end > step:
             segments.append(f"{end - step}:{size}")
     return ",".join(segments)
+
+
+def _host_spec(scenario: Scenario) -> str:
+    """The scenario's emulated-host layout as the kfrun -H spec
+    (loopback aliases in host-index order); "" for the default
+    single-host shape. Pure: derives from the spec's `hosts` alone."""
+    if len(scenario.hosts) < 2:
+        return ""
+    return ",".join(f"127.0.0.{i + 1}:{slots}"
+                    for i, slots in enumerate(scenario.hosts))
 
 
 def _size_at(scenario: Scenario, step: int) -> int:
@@ -146,7 +166,17 @@ def compile_scenario(scenario) -> ScenarioPlan:
                                {"type": "preempt_warning",
                                 "step": warn_step,
                                 "lead_steps": lead}))
-            if ev.get("rank") is None or ev.get("scope") == "cluster":
+            if ev.get("host") is not None:
+                # whole-host spot reclamation: every colocated rank
+                # consumes its own copy of the fault and dies at the
+                # step boundary; survivors on other hosts recover
+                faults.append((step, {
+                    "type": "crash_host", "host": int(ev["host"]),
+                    "step": step,
+                    "signal": str(ev.get("signal", "KILL")),
+                }))
+                needs_recover = True
+            elif ev.get("rank") is None or ev.get("scope") == "cluster":
                 cluster_preempts.append(ev)
             else:
                 faults.append((step, {
@@ -270,4 +300,5 @@ def compile_scenario(scenario) -> ScenarioPlan:
         needs_ckpt=needs_ckpt,
         description=scenario.description,
         notes=tuple(notes),
+        hosts=_host_spec(scenario),
     )

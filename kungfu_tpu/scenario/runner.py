@@ -48,7 +48,8 @@ from .compiler import ScenarioPlan, compile_scenario
 
 #: fault types that fire inside worker processes (KF_CHAOS env path);
 #: http faults fire in the config-server process instead
-_WORKER_FAULTS = ("crash_worker", "straggler_worker", "preempt_warning")
+_WORKER_FAULTS = ("crash_worker", "crash_host", "straggler_worker",
+                  "preempt_warning")
 _HTTP_FAULTS = ("delay_http", "refuse_http", "die_config_server")
 
 
@@ -191,6 +192,10 @@ def run_scenario(scenario, *, trace_dir: str,
                              else None),
                 expect_rc=phase.expect_rc,
                 server=server,
+                # multi-host scenarios (host-scoped preempts) launch
+                # one kfrun per emulated host so each host has a real
+                # supervisor to detect its own deaths
+                hosts=plan.hosts,
             )
         finally:
             if http_faults:

@@ -16,11 +16,12 @@ schedule-purity pass).
 Spec format::
 
     {"name": "spot2", "np0": 2, "steps": 14, "device_batch": 64,
-     "seed": 0,
+     "seed": 0, "hosts": [1, 1],
      "events": [
        {"kind": "preempt", "step": 8, "scope": "cluster",
-        "lead_steps": 2},                      # spot reclaim, whole host
+        "lead_steps": 2},                  # spot reclaim, whole cluster
        {"kind": "preempt", "step": 5, "rank": 2},   # one worker dies
+       {"kind": "preempt", "step": 6, "host": 1},   # whole host dies
        {"kind": "resize", "step": 4, "size": 3},    # diurnal points
        {"kind": "straggler", "step": 4, "rank": 1,
         "duration_steps": 4, "ms": 120},
@@ -33,11 +34,15 @@ Spec format::
 
 Event kinds (each validated by `load_scenario`):
 
-- ``preempt`` — ``scope: "cluster"`` (default when no rank) kills
-  every worker at ``step`` (the spot-reclaim shape; the run must then
-  cold-restore from the durable checkpoint tier), a pinned ``rank``
-  kills one worker (survivor recovery handles it). ``lead_steps``
-  schedules a `preempt_warning` chaos marker that many steps earlier.
+- ``preempt`` — ``scope: "cluster"`` (default when no rank/host)
+  kills every worker at ``step`` (the spot-reclaim shape; the run must
+  then cold-restore from the durable checkpoint tier), a pinned
+  ``rank`` kills one worker (survivor recovery handles it), a pinned
+  ``host`` kills EVERY worker on that emulated host (the whole-host
+  spot-reclamation shape, lowered to the ``crash_host`` chaos fault;
+  the cross-host survivors recover and the schedule re-grows — needs a
+  multi-host ``hosts`` layout). ``lead_steps`` schedules a
+  `preempt_warning` chaos marker that many steps earlier.
 - ``resize`` — the cluster-size timeline changes to ``size`` at
   ``step`` (diurnal availability curves are a list of these).
 - ``straggler`` — ``rank`` sleeps ``ms`` per step for
@@ -79,7 +84,13 @@ _REQUIRED = {
 class Scenario:
     """A validated scenario spec. Plain data: nothing here may read
     clocks, env or tensors — the compiler derives the whole plan from
-    these fields alone."""
+    these fields alone.
+
+    ``hosts`` is the emulated-host layout: per-host worker-slot
+    counts, in host-index order (``[2, 2]`` = two hosts of two slots —
+    loopback aliases 127.0.0.1 + 127.0.0.2 at replay time). Empty =
+    one host, the pre-existing single-runner shape. Host-scoped
+    preempt events index into this list."""
 
     name: str
     np0: int
@@ -89,13 +100,14 @@ class Scenario:
     seed: int = 0
     env: Dict[str, str] = field(default_factory=dict)
     description: str = ""
+    hosts: List[int] = field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps({
             "name": self.name, "np0": self.np0, "steps": self.steps,
             "events": self.events, "device_batch": self.device_batch,
             "seed": self.seed, "env": self.env,
-            "description": self.description,
+            "description": self.description, "hosts": self.hosts,
         }, sort_keys=True)
 
 
@@ -133,6 +145,23 @@ def load_scenario(spec) -> Scenario:
     events = spec.get("events", [])
     if not isinstance(events, list):
         raise ValueError(f"scenario {name!r}: 'events' must be a list")
+    hosts = spec.get("hosts", [])
+    if not isinstance(hosts, list) or not all(
+            isinstance(h, int) and h > 0 for h in hosts):
+        raise ValueError(
+            f"scenario {name!r}: 'hosts' must be a list of positive "
+            f"per-host slot counts (got {hosts!r})")
+    if hosts:
+        # capacity is plan data: a layout the timeline cannot fit
+        # would boot the cluster and only fail mid-replay at a spawn
+        peak = max([np0] + [int(e["size"]) for e in events
+                            if isinstance(e, dict)
+                            and e.get("kind") == "resize"
+                            and "size" in e])
+        if sum(hosts) < peak:
+            raise ValueError(
+                f"scenario {name!r}: hosts layout {hosts} has "
+                f"{sum(hosts)} slot(s) but the timeline needs {peak}")
     for n, ev in enumerate(events):
         if not isinstance(ev, dict):
             raise ValueError(f"scenario {name!r}: event {n} is not an "
@@ -151,6 +180,23 @@ def load_scenario(spec) -> Scenario:
             raise ValueError(
                 f"scenario {name!r}: {kind} event {n} step "
                 f"{ev['step']} outside [0, {steps}]")
+        if kind == "preempt" and ev.get("host") is not None:
+            if ev.get("rank") is not None:
+                raise ValueError(
+                    f"scenario {name!r}: preempt event {n} pins both "
+                    "'rank' and 'host' — pick one scope")
+            h = int(ev["host"])
+            if not 0 <= h < max(len(hosts), 1):
+                raise ValueError(
+                    f"scenario {name!r}: preempt event {n} host {h} "
+                    f"outside the declared hosts layout "
+                    f"({len(hosts)} host(s)) — a half-parsed host "
+                    "scope would replay a different trace")
+            if len(hosts) < 2:
+                raise ValueError(
+                    f"scenario {name!r}: a host-scoped preempt needs "
+                    "a multi-host 'hosts' layout (killing the only "
+                    "host is a cluster preempt — say scope: cluster)")
     env = spec.get("env", {})
     if not isinstance(env, dict) or not all(
             isinstance(k, str) and isinstance(v, str)
@@ -163,6 +209,7 @@ def load_scenario(spec) -> Scenario:
         seed=int(spec.get("seed", 0)),
         env=dict(env),
         description=str(spec.get("description", "")),
+        hosts=[int(h) for h in hosts],
     )
 
 
@@ -201,6 +248,29 @@ def spot_kill_regrow(np0: int = 3) -> Scenario:
         ],
         "description": "spot-preempt one worker at step 5; survivor "
                        "recovery + schedule-driven re-grow",
+    })
+
+
+def spot_host_kill(np0: int = 4) -> Scenario:
+    """Whole-host spot reclamation: np0 ranks over two emulated hosts,
+    and host 1 — master, leaves, shm rings and all — is reclaimed at
+    step 6 with a 1-step warning. The cross-host survivors detect the
+    burst (ring hello-EOF / socket error), ride the survivor-recovery
+    path through the dead host's runner's single shrunken proposal,
+    and the schedule re-grows back onto the reclaimed host. Lost work
+    = the survivors' discarded attempt at the failed step, priced next
+    to spot_kill_regrow's one-worker shape."""
+    a = (np0 + 1) // 2
+    return load_scenario({
+        "name": "spot_host_kill", "np0": np0, "steps": 12,
+        "hosts": [a, max(np0 - a, 1)],
+        "events": [
+            {"kind": "preempt", "step": 6, "host": 1, "lead_steps": 1},
+        ],
+        "description": "whole-host spot reclamation at step 6 "
+                       "(1-step warning): every rank on host 1 dies "
+                       "at once; survivor recovery + schedule-driven "
+                       "re-grow onto the reclaimed host",
     })
 
 
@@ -276,6 +346,7 @@ def flaky_net(np0: int = 2) -> Scenario:
 CANNED = {
     "spot_preempt": spot_preempt,
     "spot_kill_regrow": spot_kill_regrow,
+    "spot_host_kill": spot_host_kill,
     "diurnal": diurnal,
     "straggler_transient": straggler_transient,
     "flaky_control": flaky_control,

@@ -260,8 +260,10 @@ def recovery_decomposition(events: List[Dict]
     twin of ``benchmarks.recovery.decompose`` (which parses KF_MTTR
     stdout markers). Phase boundaries (all wall ms):
 
-    crash    = the chaos.crash_worker instant (victim's own record,
-               dumped to its flight file BEFORE the signal fired)
+    crash    = the chaos.crash_worker / chaos.crash_host instant (the
+               victims' own records, dumped to their flight files
+               BEFORE the signal fired; a whole-host kill contributes
+               one per victim and the earliest anchors the window)
     detect   = the runner's recovery.detect instant
     propose  = the runner's recovery.propose instant
     adopted  = the slowest survivor's recovery.adopt span END
@@ -276,7 +278,7 @@ def recovery_decomposition(events: List[Dict]
         return [(e["ts"] + e.get("dur", 0)) / 1e3 for e in events
                 if e.get("name") == name and e.get("ph") == "X"]
 
-    crash = starts("chaos.crash_worker")
+    crash = starts("chaos.crash_worker") + starts("chaos.crash_host")
     detect = starts("recovery.detect")
     proposed = starts("recovery.propose")
     adopted = ends("recovery.adopt")

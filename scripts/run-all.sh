@@ -226,6 +226,25 @@ for p in ps:
 print("HIER+SHM SMOKE OK")
 EOF
 
+echo "== [4g/7] fault-tolerant hier+shm: master-kill recovery smoke over two hosts =="
+# the robustness analog of 4f (docs/fault_tolerance.md "host death"):
+# np=4 over two emulated hosts (one kfrun per host) with KF_HIER=1 and
+# the shm rings on the wire; a chaos schedule SIGKILLs host 2's MASTER
+# mid-step. Survivors — including the dead master's colocated leaf,
+# promoted to master by the recovery re-derivation — must shrink
+# through the survivor path, keep loss continuity, and the schedule
+# re-grows back to 4. The harness asserts every RECOVERY_MARKER.
+timeout 300 python - <<'EOF'
+from kungfu_tpu.elastic.harness import run_survivor_recovery
+logs = run_survivor_recovery(
+    crash_rank=2, crash_step=5, total_steps=12, start_np=4,
+    hosts="127.0.0.1:2,127.0.0.2:2", port_range="26000-26999",
+    timeout=240, extra_env={"KF_HIER": "1"})
+assert "KF_RECOVERY_DONE rank=0 size=3" in logs, logs[-2000:]
+assert "size=4 step=12" in logs, logs[-2000:]
+print("MASTER-KILL HIER+SHM RECOVERY SMOKE OK")
+EOF
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

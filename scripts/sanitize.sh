@@ -7,8 +7,13 @@
 # in-place broadcast via send==recv aliasing inside Session::broadcast,
 # the compressed-gradient wire round — per-bucket f32 scale negotiation
 # + saturating int8 sum_sat payload, the grad-pipeline protocol — store
-# ops, epoch switch, and a KF_HIER=1 hierarchical round over two
-# simulated hosts with link-class byte assertions) under each sanitizer
+# ops, epoch switch, a KF_HIER=1 hierarchical round over two simulated
+# hosts with link-class byte assertions, the TORN-FRAME round — a
+# KF_SHM_INJECT_CORRUPT-seeded ring-frame checksum violation must
+# surface as KF_ERR_CORRUPT, never a wrong sum, and the next epoch must
+# heal — and the DEGRADED-TRANSPORT round — receiver refuses to map the
+# ring, the pair falls back to sockets pre-payload, counted, zero shm
+# bytes) under each sanitizer
 # and loops it, so the threaded transport/session/shm-ring/peer paths —
 # the class the round-7 Server::stop hang lived in — are exercised
 # under instrumentation, with suppression files from

@@ -70,6 +70,27 @@ def test_fault_matching_is_exact_and_bounded():
     assert s.take("crash_worker", rank=1, step=5) is None  # count drained
 
 
+def test_crash_host_matches_on_host_coordinate():
+    """crash_host pins (host, step): every rank passing its own host
+    index consumes its replica of the fault — exactly the colocated
+    set dies, nobody else (the on_step hook feeds `Peer.host_index`)."""
+    s = chaos.load({"faults": [
+        {"type": "crash_host", "host": 1, "step": 5}]})
+    assert s.take("crash_host", host=0, step=5) is None
+    assert s.take("crash_host", host=1, step=4) is None
+    assert s.take("crash_host", host=1, step=5) is not None
+    assert s.take("crash_host", host=1, step=5) is None  # consumed
+    chaos.load(None)
+
+
+def test_crash_host_is_a_known_schedule_type():
+    # a schedule naming it parses; a typo'd sibling does not
+    chaos.ChaosSchedule({"faults": [
+        {"type": "crash_host", "host": 0, "step": 1}]})
+    with pytest.raises(ValueError, match="unknown fault type"):
+        chaos.ChaosSchedule({"faults": [{"type": "crash_hosts"}]})
+
+
 def test_unpinned_coordinates_are_wildcards():
     s = chaos.load({"faults": [{"type": "refuse_http", "count": 3}]})
     # no "path" pinned: matches any path, three times

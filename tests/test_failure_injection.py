@@ -149,6 +149,66 @@ def test_survivor_recovery_after_chaos_worker_kill(tmp_path):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
+def test_host_master_death_recovery_hier_shm_grad_pipeline(tmp_path):
+    """ISSUE 14 acceptance: SIGKILL a HOST MASTER mid-step at np=4
+    over two emulated hosts (one kfrun per host) with KF_HIER=1, the
+    shm rings carrying the intra-host edges and the bucketed gradient
+    pipeline on the wire. Survivors — including the dead master's
+    colocated leaf, whose ring peer vanished — must detect via
+    hello-EOF/socket error, ride the survivor path, re-derive the
+    hierarchy over the survivors (the leaf is promoted to master), and
+    finish the run at full size with loss continuity. The structured
+    and marker MTTR decompositions must both complete and agree."""
+    from kungfu_tpu.benchmarks.recovery import (check_agreement,
+                                                decompose,
+                                                decompose_events)
+    from kungfu_tpu.elastic.harness import run_survivor_recovery
+
+    trace_dir = str(tmp_path / "kftrace")
+    logs = run_survivor_recovery(
+        crash_rank=2,  # host 2's master (ranks 2,3 live on 127.0.0.2)
+        crash_step=5, total_steps=12, start_np=4,
+        hosts="127.0.0.1:2,127.0.0.2:2",
+        port_range="27100-27999", timeout=300,
+        extra_env={"KF_HIER": "1", "KF_GRAD_BUCKET_MB": "0.25",
+                   "KF_TRACE": "1", "KF_TRACE_DIR": trace_dir})
+    assert "KF_RECOVERY_DONE rank=0 size=3" in logs, logs[-3000:]
+    assert "size=4 step=12" in logs, logs[-3000:]
+    assert "KF_JOINER_CONTINUITY" in logs, logs[-3000:]
+    d_markers = decompose(logs)
+    d_events = decompose_events(trace_dir)
+    assert d_markers is not None, logs[-3000:]
+    assert d_events is not None, "structured MTTR timeline incomplete"
+    assert not check_agreement(d_markers, d_events)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_whole_host_death_recovery_hier_shm(tmp_path):
+    """ISSUE 14 acceptance: the crash_host chaos fault SIGKILLs EVERY
+    rank on one emulated host (master + leaf + their rings) at a step
+    boundary. The dead host's runner reaps the burst as ONE shrunken
+    proposal and LINGERS; cross-host survivors recover at half size,
+    and the schedule re-grows back onto the reclaimed host."""
+    from kungfu_tpu.elastic.harness import run_survivor_recovery
+
+    logs = run_survivor_recovery(
+        crash_host=1, crash_step=5, total_steps=12, start_np=4,
+        hosts="127.0.0.1:2,127.0.0.2:2",
+        port_range="27100-27999", timeout=300,
+        extra_env={"KF_HIER": "1"})
+    # both victims fired their own flight-anchored chaos markers
+    assert logs.count("type=crash_host") >= 2, logs[-3000:]
+    # ONE batched proposal took the cluster straight to the survivors
+    assert "KF_RECOVERY_DONE rank=0 size=2" in logs, logs[-3000:]
+    # the emptied host's runner lingered and respawned the joiners
+    assert "lingering" in logs, logs[-3000:]
+    assert "KF_JOINER_CONTINUITY" in logs, logs[-3000:]
+    assert "size=4 step=12" in logs, logs[-3000:]
+
+
+@pytest.mark.chaos
 def test_whole_cluster_kill_restores_from_sharded_checkpoint(tmp_path):
     """The durable rung: the ONE fault class survivor recovery cannot
     cover. A chaos schedule SIGKILLs EVERY worker at the same step

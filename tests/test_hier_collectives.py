@@ -15,17 +15,34 @@ on the ISSUE-13 acceptance contract (docs/collectives.md):
   both with validated parsing through env.CONFIG_VARS;
 - the hierarchy is re-derived from the PeerList on every epoch switch.
 
+Plus the ISSUE-14 failure-semantics contract
+(docs/collectives.md "Failure semantics"):
+
+- a corrupted/torn shm-ring frame is DETECTED (header checksum +
+  length validation) and surfaces as KF_ERR_CORRUPT — never a silent
+  wrong sum — and the next epoch switch heals the transport;
+- stale ring debris from crashed runs is swept at startup
+  (KF_SHM_SWEEP=0 opts out); live handshake files are untouched;
+- shm establishment failure degrades to sockets pre-payload, counted
+  (shm_fallbacks / kf_link_fallback_total) and retried at the next
+  epoch switch; KF_SHM_REQUIRE=1 turns the degradation into an error;
+- a master death promotes a surviving leaf to host master in the
+  re-derived hierarchy (Python mirror AND native behavior).
+
 Two simulated hosts = 127.0.0.1 + 127.0.0.2 (both loopback, distinct
 ipv4 => not colocated, exactly how kfrun -H emulates hosts).
 """
 
+import os
 import threading
+import time
 
 import numpy as np
 import pytest
 
 from kungfu_tpu import env as kfenv
-from kungfu_tpu.ffi import LINK_CLASSES, NativePeer
+from kungfu_tpu.ffi import (KF_ERR, KF_ERR_CORRUPT, LINK_CLASSES,
+                            KfError, NativePeer)
 
 BASE_PORT = 23300
 _port_lock = threading.Lock()
@@ -80,6 +97,27 @@ def run_on_all(peers, fn):
 def close_all(peers):
     for p in peers:
         p.close()
+
+
+def run_collect(peers, fn):
+    """Like run_on_all but returns (results, errors) instead of
+    raising — failure-semantics tests need EVERY rank's outcome."""
+    results = [None] * len(peers)
+    errors = {}
+
+    def work(i):
+        try:
+            results[i] = fn(peers[i], i)
+        except Exception as e:  # noqa: BLE001
+            errors[i] = e
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(len(peers))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
 
 
 def allreduce_rows(peers, payload_per_rank, name="ar"):
@@ -340,9 +378,245 @@ class TestHierarchical:
             close_all(peers)
 
 
+class TestRingIntegrity:
+    def test_corrupt_frame_detected_never_summed_then_heals(
+            self, monkeypatch):
+        """A corrupted ring frame (KF_SHM_INJECT_CORRUPT arms the
+        one-shot seeded-chaos flip of the next frame's checksum) must
+        surface as KF_ERR_CORRUPT on the receiving rank and NEVER as a
+        silently wrong sum; the next epoch switch rebuilds clean rings
+        and sums are exact again. One test owns the whole lifecycle:
+        the injection latch is one-shot per process."""
+        monkeypatch.delenv("KF_SHM", raising=False)
+        monkeypatch.setenv("KF_SHM_INJECT_CORRUPT", "1")
+        payload = [np.full(900, float(i + 1), np.float32)
+                   for i in range(2)]
+        peers = make_cluster([2], strategy="STAR", timeout_ms=5000)
+        try:
+            results, errors = run_collect(
+                peers, lambda p, i: p.all_reduce(payload[i], name="cx"))
+            # rank 0 (STAR root) receives the corrupted reduce frame
+            assert errors, "corrupt frame was not detected"
+            codes = {i: getattr(e, "code", None)
+                     for i, e in errors.items()}
+            assert KF_ERR_CORRUPT in codes.values(), (codes, errors)
+            # nobody may hold a wrong sum
+            for i, r in enumerate(results):
+                if r is not None:
+                    np.testing.assert_array_equal(
+                        r, np.full(900, 3.0, np.float32))
+            # epoch switch: clean rings under the new token (the
+            # injection latch already fired), exact sums, and the shm
+            # path is back in use
+            monkeypatch.delenv("KF_SHM_INJECT_CORRUPT")
+            spec = ",".join(peers[0].spec_list)
+            before = [p.link_stats()["egress"]["shm"] for p in peers]
+            for p in peers:
+                p.update(spec, 1)
+            out, errs = run_collect(
+                peers, lambda p, i: p.all_reduce(payload[i],
+                                                 name="healed"))
+            assert not errs, errs
+            for r in out:
+                np.testing.assert_array_equal(
+                    r, np.full(900, 3.0, np.float32))
+            after = [p.link_stats()["egress"]["shm"] for p in peers]
+            assert sum(after) > sum(before), (before, after)
+        finally:
+            close_all(peers)
+
+    def test_stale_ring_debris_swept_at_startup(self, monkeypatch):
+        """Server start unlinks old *.ring files under the per-uid
+        /dev/shm dir (a producer SIGKILLed mid-handshake leaks its
+        segment file); fresh files — a live handshake — survive, and
+        KF_SHM_SWEEP=0 opts out entirely."""
+        monkeypatch.delenv("KF_SHM", raising=False)
+        monkeypatch.delenv("KF_SHM_SWEEP", raising=False)
+        shm_dir = f"/dev/shm/kf-u{os.getuid()}"
+        os.makedirs(shm_dir, mode=0o700, exist_ok=True)
+        stale = os.path.join(shm_dir, "deadbeef-stale-test.ring")
+        fresh = os.path.join(shm_dir, "deadbeef-fresh-test.ring")
+        try:
+            for path in (stale, fresh):
+                with open(path, "wb") as f:
+                    f.write(b"\0" * 64)
+            old = time.time() - 600
+            os.utime(stale, (old, old))
+            peers = make_cluster([1])
+            close_all(peers)
+            assert not os.path.exists(stale), "stale debris not swept"
+            assert os.path.exists(fresh), "live handshake file swept"
+            # opt-out: the stale file survives a new cluster boot
+            with open(stale, "wb") as f:
+                f.write(b"\0" * 64)
+            os.utime(stale, (old, old))
+            monkeypatch.setenv("KF_SHM_SWEEP", "0")
+            peers = make_cluster([1])
+            close_all(peers)
+            assert os.path.exists(stale), "KF_SHM_SWEEP=0 ignored"
+        finally:
+            for path in (stale, fresh):
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+
+
+class TestDegradedTransport:
+    def test_attach_failure_falls_back_counts_and_retries(
+            self, monkeypatch):
+        """Ring establishment failure (receiver refuses to map — the
+        deterministic /dev/shm-ENOSPC stand-in) degrades to sockets
+        BEFORE any payload byte: sums stay exact, the pair is counted
+        in shm_fallbacks, no byte claims the shm link class — and the
+        next epoch switch RETRIES shm and succeeds."""
+        monkeypatch.delenv("KF_SHM", raising=False)
+        monkeypatch.delenv("KF_SHM_REQUIRE", raising=False)
+        monkeypatch.setenv("KF_SHM_INJECT_ATTACH_FAIL", "1")
+        payload = [np.full(700, float(i + 1), np.float32)
+                   for i in range(2)]
+        peers = make_cluster([2], strategy="STAR")
+        try:
+            out = allreduce_rows(peers, payload, name="fb")
+            for r in out:
+                np.testing.assert_array_equal(
+                    r, np.full(700, 3.0, np.float32))
+            assert sum(p.shm_fallbacks for p in peers) >= 1
+            for p in peers:
+                eg = p.link_stats()["egress"]
+                assert eg["shm"] == 0, eg
+            assert sum(p.link_stats()["egress"]["unix"]
+                       for p in peers) > 0
+            # the degraded mode dies with its epoch: next switch
+            # re-establishes the rings
+            monkeypatch.delenv("KF_SHM_INJECT_ATTACH_FAIL")
+            spec = ",".join(peers[0].spec_list)
+            for p in peers:
+                p.update(spec, 1)
+            out = allreduce_rows(peers, payload, name="fb2")
+            for r in out:
+                np.testing.assert_array_equal(
+                    r, np.full(700, 3.0, np.float32))
+            assert sum(p.link_stats()["egress"]["shm"]
+                       for p in peers) > 0, "epoch switch did not retry"
+        finally:
+            close_all(peers)
+
+    def test_shm_require_turns_fallback_into_loud_error(
+            self, monkeypatch):
+        """KF_SHM_REQUIRE=1: a would-be degradation is a hard error —
+        benchmark runs must never silently measure the socket path."""
+        monkeypatch.delenv("KF_SHM", raising=False)
+        monkeypatch.setenv("KF_SHM_INJECT_ATTACH_FAIL", "1")
+        monkeypatch.setenv("KF_SHM_REQUIRE", "1")
+        peers = make_cluster([2], strategy="STAR", timeout_ms=6000)
+        try:
+            _, errors = run_collect(
+                peers, lambda p, i: p.all_reduce(
+                    np.ones(64, np.float32), name="req"))
+            assert errors, "KF_SHM_REQUIRE did not fail the collective"
+            assert any(isinstance(e, KfError)
+                       and getattr(e, "code", None) == KF_ERR
+                       for e in errors.values()), errors
+        finally:
+            close_all(peers)
+
+    def test_fallback_visible_on_metrics_registry(self, monkeypatch):
+        """kf_link_fallback_total reaches /metrics via
+        Peer.publish_link_metrics (docs/observability.md) — the
+        degraded mode must be visible to a scraper, not just in
+        logs."""
+        from kungfu_tpu.trace.metrics import REGISTRY
+
+        class _FakePeer:
+            shm_fallbacks = 2
+
+            def link_stats(self):
+                zero = {c: 0 for c in LINK_CLASSES}
+                return {"egress": dict(zero), "ingress": dict(zero)}
+
+        from kungfu_tpu.peer import Peer
+        fake = _FakePeer()
+        before = REGISTRY.read("kf_link_fallback_total")
+        Peer.publish_link_metrics(fake)
+        assert REGISTRY.read("kf_link_fallback_total") == before + 2
+        # idempotent on no change: the counter publishes deltas
+        Peer.publish_link_metrics(fake)
+        assert REGISTRY.read("kf_link_fallback_total") == before + 2
+
+
+class TestPromotedMaster:
+    """Master death => a surviving leaf is promoted to host master by
+    the recovery re-derivation (ISSUE 14 pin)."""
+
+    def test_python_mirror_promotes_surviving_leaf(self):
+        from kungfu_tpu.plan import PeerList
+        from kungfu_tpu.plan.topology import gen_hierarchy_pairs
+
+        peers = PeerList.parse("10.0.0.1:1,10.0.0.1:2,"
+                               "10.0.0.2:1,10.0.0.2:2")
+        # masters before: rank 0 (host 1) and rank 2 (host 2)
+        survivors = PeerList([peers[0], peers[1], peers[3]])
+        # 10.0.0.2:2 — rank 3 before, a LEAF — is now rank 2 and must
+        # master host 2: every cross-host edge touches only ranks
+        # {0, 2} of the survivor list
+        for rg, bg in gen_hierarchy_pairs("STAR", survivors):
+            for g in (rg, bg):
+                for i in range(g.n):
+                    for j in g.nexts(i):
+                        if survivors[i].ipv4 != survivors[j].ipv4:
+                            assert {i, j} <= {0, 2}, (i, j)
+        # and the promoted master actually carries cross-host edges
+        crosses = [
+            (i, j)
+            for rg, bg in gen_hierarchy_pairs("STAR", survivors)
+            for g in (rg, bg)
+            for i in range(g.n)
+            for j in g.nexts(i)
+            if survivors[i].ipv4 != survivors[j].ipv4
+        ]
+        assert any(2 in edge for edge in crosses), crosses
+
+    def test_native_promotion_after_master_shrink(self, monkeypatch):
+        """Behavioral pin: shrink away host 2's master; the surviving
+        leaf is re-derived as master and now carries the inter-host
+        (tcp) traffic; sums stay exact."""
+        monkeypatch.delenv("KF_SHM", raising=False)
+        monkeypatch.setenv("KF_HIER", "1")
+        peers = make_cluster([2, 2], strategy="STAR")
+        try:
+            allreduce_rows(peers, rank_payloads(4, size=512,
+                                                integer_valued=True),
+                           name="pm0")
+            # rank 3 is a LEAF: all its egress rides shm
+            assert peers[3].link_stats()["egress"]["tcp"] == 0
+            survivors = [peers[0], peers[1], peers[3]]
+            new_spec = ",".join(peers[0].spec_list[:2]
+                                + peers[0].spec_list[3:])
+            tcp_before = peers[3].link_stats()["egress"]["tcp"]
+            for p in survivors:
+                p.update(new_spec, 1)
+            assert all(p.hierarchical for p in survivors)
+            out, errs = run_collect(
+                survivors, lambda p, i: p.all_reduce(
+                    np.full(2048, float(i + 1), np.float32),
+                    name="pm1"))
+            assert not errs, errs
+            for r in out:
+                np.testing.assert_array_equal(
+                    r, np.full(2048, 6.0, np.float32))
+            # the promoted master now owns host 2's inter-host edge
+            assert peers[3].link_stats()["egress"]["tcp"] > tcp_before
+        finally:
+            close_all(peers)
+
+
 class TestEnvKnobs:
     def test_new_vars_in_config_vars(self):
-        for var in ("KF_SHM", "KF_HIER", "KF_NO_UNIX_SOCKET"):
+        for var in ("KF_SHM", "KF_HIER", "KF_NO_UNIX_SOCKET",
+                    "KF_SHM_REQUIRE", "KF_SHM_SWEEP",
+                    "KF_SHM_INJECT_CORRUPT",
+                    "KF_SHM_INJECT_ATTACH_FAIL"):
             assert var in kfenv.CONFIG_VARS
 
     def test_launcher_forwards_transport_vars(self, monkeypatch):
@@ -357,7 +631,10 @@ class TestEnvKnobs:
         assert env["KF_NO_UNIX_SOCKET"] == "1"
 
     @pytest.mark.parametrize("var", ["KF_SHM", "KF_HIER",
-                                     "KF_NO_UNIX_SOCKET"])
+                                     "KF_NO_UNIX_SOCKET",
+                                     "KF_SHM_REQUIRE", "KF_SHM_SWEEP",
+                                     "KF_SHM_INJECT_CORRUPT",
+                                     "KF_SHM_INJECT_ATTACH_FAIL"])
     def test_garbage_flag_raises_at_bootstrap(self, var):
         e = {kfenv.SELF_SPEC: "127.0.0.1:10000",
              kfenv.INIT_PEERS: "127.0.0.1:10000", var: "yes"}

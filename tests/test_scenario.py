@@ -100,6 +100,49 @@ def test_rank_preempt_lowers_to_crash_fault_plus_recover():
     assert plan.needs_recover and phase.env.get("KF_RECOVER") == "1"
 
 
+def test_host_preempt_lowers_to_crash_host_plus_hosts_spec():
+    """A host-scoped preempt lowers to the crash_host fault, arms
+    recovery, and the scenario's hosts layout becomes the loopback
+    multi-runner -H spec the replay launches with."""
+    plan = compile_scenario(canned("spot_host_kill", np0=4))
+    (phase,) = plan.phases
+    faults = phase.chaos["faults"]
+    crash = [f for f in faults if f["type"] == "crash_host"]
+    warn = [f for f in faults if f["type"] == "preempt_warning"]
+    assert crash == [{"type": "crash_host", "host": 1, "step": 6,
+                      "signal": "KILL"}]
+    assert warn and warn[0]["step"] == 5  # lead_steps=1
+    assert plan.needs_recover and phase.env.get("KF_RECOVER") == "1"
+    assert plan.hosts == "127.0.0.1:2,127.0.0.2:2"
+    assert not plan.needs_ckpt  # survivors recover; no cold boot
+
+
+def test_host_preempt_validation_is_loud():
+    base = {"name": "h", "np0": 4, "steps": 8, "hosts": [2, 2]}
+    # host outside the layout
+    with pytest.raises(ValueError, match="outside the declared"):
+        load_scenario({**base, "events": [
+            {"kind": "preempt", "step": 2, "host": 2}]})
+    # host scope without a multi-host layout
+    with pytest.raises(ValueError, match="multi-host"):
+        load_scenario({"name": "h", "np0": 2, "steps": 8, "events": [
+            {"kind": "preempt", "step": 2, "host": 0}]})
+    # rank and host together is ambiguous
+    with pytest.raises(ValueError, match="pick one scope"):
+        load_scenario({**base, "events": [
+            {"kind": "preempt", "step": 2, "host": 1, "rank": 0}]})
+    # garbage hosts layout
+    with pytest.raises(ValueError, match="hosts"):
+        load_scenario({**base, "hosts": [2, 0]})
+    # layout too small for np0 / the resize timeline: reject at load,
+    # not mid-replay at a spawn
+    with pytest.raises(ValueError, match="needs 4"):
+        load_scenario({**base, "hosts": [1, 1]})
+    with pytest.raises(ValueError, match="needs 5"):
+        load_scenario({**base, "events": [
+            {"kind": "resize", "step": 2, "size": 5}]})
+
+
 def test_cluster_preempt_lowers_to_phases_with_cold_boot():
     plan = compile_scenario(canned("spot_preempt", np0=2))
     assert len(plan.phases) == 2 and plan.needs_ckpt
@@ -264,6 +307,7 @@ def test_spot_preempt_replay_goodput_accounting(tmp_path):
 @pytest.mark.chaos
 @pytest.mark.parametrize("name,expect_phase", [
     ("spot_kill_regrow", "recovery"),   # survivor recovery + re-grow
+    ("spot_host_kill", "recovery"),     # whole-host burst + re-grow
     ("diurnal", "resize"),              # planned grow/drain resyncs
     ("flaky_control", "hook"),          # control-plane flap -> retries
 ])
