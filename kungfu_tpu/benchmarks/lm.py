@@ -18,12 +18,11 @@ import functools
 import json
 import time
 
-SIZES = {
-    # name -> (hidden, layers, heads, intermediate)
-    "tiny": (128, 2, 8, 256),
-    "small": (768, 12, 12, 3072),   # GPT-2 124M
-    "medium": (1024, 24, 16, 4096),  # GPT-2 350M
-}
+# the canonical GPT size table lives with the serving tier
+# (kungfu_tpu/serve/engine.py) — one model/params setup serves both
+# the decode benchmark and the decode tier, so they cannot drift;
+# re-exported here for the historical import path
+from kungfu_tpu.serve.engine import SIZES
 
 # Peak bf16 FLOP/s per chip, keyed by jax device_kind. MFU is only
 # reported for kinds listed here — a hard-coded peak on an unknown
@@ -337,49 +336,29 @@ def measure_decode_rate(size: str = "small", batch: int = 8,
     """Generated tokens/sec of KV-cached autoregressive decoding.
 
     `tp` > 1 serves with Megatron-sharded weights: gpt_generate is pure
-    traced JAX, so jitting it over `gpt_tp_rules`-sharded params lets
+    traced JAX, so jitting it over serve-table-sharded params lets
     GSPMD propagate the head sharding into the KV caches and insert the
     ICI collectives — the standard TPU serving layout
     (token-exact parity with tp=1: tests/test_gpt.py::TestGenerate).
-    """
-    import numpy as np
 
+    Model/params(+sharding) setup is `serve.engine.build_lm` — the
+    SAME entry point the continuous-batching decode tier boots from,
+    so this published row and the serving tier cannot drift.
+    """
     import jax
     import jax.numpy as jnp
 
-    from kungfu_tpu.models import GPTConfig, GPTLM, gpt_generate
+    from kungfu_tpu.models import gpt_generate
+    from kungfu_tpu.serve.engine import build_lm
 
     platform = jax.devices()[0].platform
     if platform == "cpu":  # smoke path
         size, batch, prompt_len, gen_len = "tiny", 2, 8, 8
         iters = 1
-    n = jax.device_count()
-    hidden, layers, heads, inter = SIZES[size]
-    # decode's mesh is (1, tp) over the first tp devices, so the real
-    # constraints are device availability and head divisibility (the
-    # QKV kernels shard over the heads dim)
-    if tp > n:
-        raise SystemExit(f"--tp {tp} exceeds device count {n}")
-    if heads % tp:
-        raise SystemExit(
-            f"--tp {tp} must divide num_heads {heads} of size={size}")
-    cfg = GPTConfig(vocab_size=50257, hidden_size=hidden,
-                    num_layers=layers, num_heads=heads,
-                    intermediate_size=inter,
-                    max_position=prompt_len + gen_len,
-                    dtype=jnp.bfloat16)
-    model = GPTLM(cfg)
+    model, params, _mesh = build_lm(size,
+                                    max_position=prompt_len + gen_len,
+                                    tp=tp)
     prompt = jnp.zeros((batch, prompt_len), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
-    if tp > 1:
-        from jax.sharding import Mesh
-
-        from kungfu_tpu.parallel import gpt_tp_rules, shard_params
-
-        mesh = Mesh(np.array(jax.devices()[:tp]).reshape(1, tp),
-                    ("data", "model"))
-        params = shard_params(jax.device_get(params), mesh,
-                              gpt_tp_rules())
 
     run = jax.jit(lambda p, t: gpt_generate(model, p, t, gen_len))
     out = run(params, prompt)            # compile + warmup

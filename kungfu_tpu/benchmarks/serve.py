@@ -1,0 +1,181 @@
+"""Serving latency x throughput x cluster size — elastically.
+
+The decode tier's operator-facing numbers (docs/serving.md): for each
+cluster size np, drive a fixed request mix through a REAL elastic
+serving cluster (config server + kfrun + `serve.worker` replicas,
+`serve.harness.run_serve_cluster`) and report per-request p50/p99
+latency plus generated tokens/sec — measured WARM (a front-loaded
+warmup batch absorbs worker boot + jit compile, the way an operator
+measures a running service, and the way every other BASELINE row
+excludes compile from its timed region).
+
+The differentiating cell is **p99 THROUGH a mid-traffic resize**: at
+np0=2, once a quarter of the measured batch has completed, the
+harness grows the tier 2 -> 3 through the consensus-resize path
+(config-server /addworker -> every worker adopts the epoch -> the
+joiner boots, adopts weights, and starts leasing) while traffic is in
+flight. Survivors' in-flight requests decode straight through the
+epoch switch (their paged KV pools are per-process state), so the
+cell reports what a resize actually costs the tail — and the run
+gates on EVERY request completing plus zero request-ledger invariant
+violations, so the number cannot be bought by dropping work.
+
+  python -m kungfu_tpu.benchmarks.serve                # the matrix
+  python -m kungfu_tpu.benchmarks.serve --np 1 2       # subset
+  python -m kungfu_tpu.benchmarks.serve --publish      # -> BASELINE
+
+1-core loopback caveat (BASELINE.md): every replica shares one CPU
+core with the config server and each other, so ABSOLUTE latencies are
+container artifacts and tok/s does NOT scale with np here; the
+portable results are the completion guarantees, the ledger-invariant
+gate, and the tail-through-resize SHAPE (p99 bounded by resize stall
++ queueing, not by request abandonment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+#: per-worker continuous-batch width for every cell: small enough
+#: that the request mix genuinely queues (admission pressure is part
+#: of what the tier is for), one knob for every row
+MAX_BATCH = 4
+
+
+def _latencies(results):
+    lat = sorted(r["latency_ms"] for r in results)
+    return lat
+
+
+def _pct(lat, q):
+    # the ledger's nearest-rank helper: ONE implementation for the
+    # published rows and the /serve/stats SLO signal
+    from kungfu_tpu.serve.ledger import percentile
+
+    return round(percentile(lat, q), 1)
+
+
+def measure_cell(np_: int, requests: int, gen_len: int,
+                 port_range: str, timeout: int,
+                 grow_when_done=None, schedule: str = "",
+                 markers=None) -> dict:
+    """One (np, request-mix) cell through the real elastic cluster."""
+    from kungfu_tpu.serve.harness import (SERVE_MARKERS,
+                                          default_requests,
+                                          run_serve_cluster)
+
+    out = run_serve_cluster(
+        default_requests(requests, gen_len=gen_len),
+        schedule=schedule,
+        start_np=np_,
+        slots=max(4, np_ + 1),
+        warmup=np_,
+        grow_when_done=grow_when_done,
+        extra_env={"KF_SERVE_MAX_BATCH": str(MAX_BATCH)},
+        port_range=port_range,
+        timeout=timeout,
+        markers=markers if markers is not None else SERVE_MARKERS,
+    )
+    lat = _latencies(out["results"])
+    toks = sum(len(r["tokens"]) for r in out["results"])
+    resumed = sum(1 for r in out["results"] if r["leases"] > 1)
+    return {
+        "np": np_,
+        "requests": requests,
+        "gen_len": gen_len,
+        "completed": sum(1 for r in out["results"]
+                         if r["state"] == "done"),
+        "p50_ms": _pct(lat, 50),
+        "p99_ms": _pct(lat, 99),
+        "tokens_per_sec": round(toks / out["measured_wall_s"], 1),
+        "measured_wall_s": out["measured_wall_s"],
+        "resumed_requests": resumed,
+    }
+
+
+def measure(np_list=(1, 2, 4), requests: int = 16, gen_len: int = 48,
+            port_base: int = 28100, timeout: int = 420) -> dict:
+    """The np sweep + the mid-traffic-resize cell."""
+    from kungfu_tpu.serve.harness import RESIZE_MARKERS
+
+    rows = []
+    port = port_base
+    for np_ in np_list:
+        rows.append(measure_cell(
+            np_, requests, gen_len,
+            port_range=f"{port}-{port + 99}", timeout=timeout))
+        print(json.dumps({"cell": "steady", **rows[-1]}), flush=True)
+        port += 100
+    # the elastic cell: grow 2 -> 3 through the consensus path once a
+    # quarter of the measured batch completed, traffic in flight
+    resize = measure_cell(
+        2, requests, gen_len,
+        port_range=f"{port}-{port + 99}", timeout=timeout,
+        grow_when_done=2 + max(requests // 4, 1),
+        markers=RESIZE_MARKERS)
+    resize["grew_to"] = 3
+    print(json.dumps({"cell": "resize", **resize}), flush=True)
+    steady2 = next((r for r in rows if r["np"] == 2), None)
+    return {
+        "cells": rows,
+        "resize_cell": resize,
+        # the tail cost of the resize, relative to the same traffic
+        # on an undisturbed np=2 tier
+        "p99_through_resize_over_steady": (
+            round(resize["p99_ms"] / steady2["p99_ms"], 3)
+            if steady2 and steady2["p99_ms"] else None),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--np", type=int, nargs="+", default=[1, 2, 4])
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--gen-len", type=int, default=48)
+    ap.add_argument("--timeout", type=int, default=420)
+    ap.add_argument("--port-base", type=int, default=28100)
+    ap.add_argument("--publish", action="store_true",
+                    help="merge into BASELINE.json and emit the "
+                         "round's BENCH file (publish.py protocol)")
+    args = ap.parse_args(argv)
+    res = measure(tuple(args.np), requests=args.requests,
+                  gen_len=args.gen_len, port_base=args.port_base,
+                  timeout=args.timeout)
+    result = {
+        "config": (
+            f"elastic decode tier: tiny GPT, {args.requests} "
+            f"requests x {args.gen_len} generated tokens per cell, "
+            f"per-worker continuous batch {MAX_BATCH}, paged KV "
+            "(16-token blocks), warm-tier measurement (warmup batch "
+            "absorbs boot+jit); resize cell grows 2->3 via "
+            "/addworker mid-traffic with completion + ledger "
+            "invariants gated (1-core loopback: absolute ms are "
+            "container artifacts; the portable result is the "
+            "completion guarantee and the tail-through-resize shape)"
+        ),
+        **res,
+    }
+    print(json.dumps({"metric": "serve_elastic_latency",
+                      "value": res["resize_cell"]["p99_ms"],
+                      "unit": "ms (p99 through mid-traffic resize)",
+                      "details": result}), flush=True)
+    if args.publish:
+        from kungfu_tpu.benchmarks.publish import publish_result
+
+        publish_result(
+            "serve_elastic_latency", result,
+            parsed={"metric": "serve_p99_through_resize_ms",
+                    "value": res["resize_cell"]["p99_ms"],
+                    "unit": "ms",
+                    "tokens_per_sec_np2":
+                        next((r["tokens_per_sec"] for r in
+                              res["cells"] if r["np"] == 2), None)},
+            cmd="python -m kungfu_tpu.benchmarks.serve --publish")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

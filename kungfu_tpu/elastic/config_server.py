@@ -12,6 +12,16 @@ tests/go/cmd/kungfu-config-server-example/kungfu-config-server-example.go):
 - GET  /stop          -> shut the server down
 - POST /trace         -> ingest one kftrace event batch (bounded)
 - GET  /trace         -> collected trace snapshot (JSON)
+- *    /serve/*       -> the decode tier's request front-end
+                         (kungfu_tpu/serve/frontend.py)
+
+The /serve family (docs/serving.md) is the serving tier's request
+ledger — submit/result at ingest, lease/append/release on the worker
+side — mounted HERE because the config server is the one address that
+survives worker churn: requests outlive the workers computing them.
+Serve traffic is exempt from the chaos HTTP hooks for the same
+request-index reason as /trace below; killing a decode worker is a
+worker-side fault (``crash_worker``), not an HTTP one.
 
 The /trace pair is the kftrace collection rendezvous
 (docs/observability.md): workers' `TraceShipper`s POST bounded event
@@ -54,6 +64,16 @@ class ConfigServer:
         from ..trace.collect import TraceStore
 
         self.trace_store = TraceStore()
+        # the decode tier's request ledger (its own internal lock;
+        # bounded admission) — docs/serving.md. Knobs parse through
+        # env.env_int/env_float so garbage fails at boot, not mid-run.
+        from ..env import env_float, env_int
+        from ..serve.ledger import RequestLedger
+
+        self.serve_ledger = RequestLedger(
+            max_queue=env_int("KF_SERVE_QUEUE", 256, minimum=1),
+            lease_ms=env_float("KF_SERVE_LEASE_MS", 10_000.0,
+                               minimum=100.0))
         self._stage: Optional[Stage] = None  # kf: guarded_by(_lock)
         self._initial: Optional[Stage] = None  # kf: guarded_by(_lock)
         # kf: guarded_by(_lock)
@@ -146,11 +166,31 @@ class ConfigServer:
                     return True
                 return False  # delay faults sleep inside the hook
 
+            def _serve(self, method: str) -> bool:
+                """Dispatch /serve/* against the request ledger; True
+                when the request was consumed. Serving plane: no
+                chaos hook (see module docstring), no stage lock."""
+                if not self.path.startswith("/serve"):
+                    return False
+                from kungfu_tpu.serve.frontend import handle_serve
+
+                n = int(self.headers.get("Content-Length", 0)) \
+                    if method != "GET" else 0
+                body = self.rfile.read(n).decode() if n else ""
+                out = handle_serve(server.serve_ledger, method,
+                                   self.path, body)
+                if out is None:
+                    return False
+                self._reply(*out)
+                return True
+
             def do_GET(self):
                 if self.path.startswith("/trace"):
                     # observability plane: no chaos hook (see module
                     # docstring), no stage lock
                     self._reply(200, server.trace_store.to_json())
+                    return
+                if self._serve("GET"):
                     return
                 if self._chaos():
                     return
@@ -168,6 +208,8 @@ class ConfigServer:
                     self._reply(404, '{"error": "unknown path"}')
 
             def _do_update(self):
+                if self._serve("POST"):
+                    return
                 if self.path.startswith("/trace"):
                     n = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(n).decode() if n else ""

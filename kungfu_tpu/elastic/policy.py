@@ -85,6 +85,91 @@ class NoiseScalePolicy:
         return None
 
 
+@dataclass
+class SLOPolicy:
+    """Queue-depth / latency-SLO sizing for the decode tier
+    (docs/serving.md) — the serving sibling of `NoiseScalePolicy`
+    (statistical signal) and `GoodputPolicy` (cost signal).
+
+    The signal is the request ledger's ``/serve/stats``: each decode
+    worker feeds ``observe()`` once per iteration, and the policy
+    proposes a size through the SAME `ElasticCallback` propose ->
+    consensus-resize path training uses. Grow when ingest outruns the
+    tier (queue depth beyond ``backlog_per_worker`` per worker, or
+    completed-request p99 above ``p99_target_ms``); shrink when the
+    tier idles (empty queue AND in-flight work fits the smaller
+    cluster) for ``idle_patience`` consecutive observations.
+    `hysteresis` consecutive identical targets are required before a
+    proposal — one bursty scrape must not churn the cluster, because
+    a serving resize stalls EVERY in-flight request for the
+    consensus + broadcast window (the p99-through-resize cell in
+    BASELINE prices exactly that).
+
+    Like the other policies, one instance runs per worker but only
+    rank 0's proposals reach the config server.
+    """
+
+    p99_target_ms: float = 0.0       # 0 = latency signal off
+    backlog_per_worker: float = 4.0
+    capacity_per_worker: int = 8     # engine max_batch
+    min_size: int = 1
+    max_size: int = 8
+    hysteresis: int = 2
+    idle_patience: int = 8
+    queue_depth: int = field(default=0, repr=False)
+    running: int = field(default=0, repr=False)
+    p99_ms: float = field(default=0.0, repr=False)
+    _idle: int = field(default=0, repr=False)
+    _pending: int = field(default=0, repr=False)
+    _streak: int = field(default=0, repr=False)
+    _seen: bool = field(default=False, repr=False)
+
+    def observe(self, queue_depth: int, running: int,
+                p99_ms: float) -> None:
+        """Feed the latest ledger stats scrape."""
+        self.queue_depth = int(queue_depth)
+        self.running = int(running)
+        self.p99_ms = float(p99_ms)
+        self._seen = True
+        if self.queue_depth == 0:
+            self._idle += 1
+        else:
+            self._idle = 0
+
+    def target_size(self, current_size: int) -> int:
+        want = current_size
+        backlogged = (self.queue_depth
+                      > self.backlog_per_worker * current_size)
+        slo_violated = (self.p99_target_ms > 0
+                        and self.p99_ms > self.p99_target_ms)
+        if backlogged or slo_violated:
+            want = current_size + 1
+        elif (self._idle >= self.idle_patience
+              and self.running <= (current_size - 1)
+              * self.capacity_per_worker):
+            want = current_size - 1
+        return max(self.min_size, min(self.max_size, want))
+
+    def __call__(self, current_size: int) -> int | None:
+        """Desired cluster size, or None to leave the tier alone."""
+        if not self._seen:
+            return None
+        want = self.target_size(current_size)
+        if want == current_size:
+            self._streak = 0
+            return None
+        if want == self._pending:
+            self._streak += 1
+        else:
+            self._pending, self._streak = want, 1
+        if self._streak >= self.hysteresis:
+            self._streak = 0
+            if want < current_size:
+                self._idle = 0  # one shrink per idle episode
+            return want
+        return None
+
+
 # -- cost-aware policies over the goodput metrics plane -----------------------
 
 class _WireSpikeReader:

@@ -79,6 +79,31 @@ CONFIG_VARS = (
     "KF_TRACE_DIR",
     "KF_TRACE_RING",
     "KF_TRACE_POST_MS",
+    # kfserve decode tier (docs/serving.md): front-end port (0 =
+    # ephemeral), per-worker continuous-batch width, paged-KV block
+    # size in tokens, the p99 latency SLO driving SLOPolicy sizing
+    # (0 = policy off), admission-queue bound and lease timeout. All
+    # parse through env_int/env_float at worker bootstrap — the
+    # KF_NO_UNIX_SOCKET lesson: a knob the launcher does not forward,
+    # or that parses by getenv-truthiness, is a knob that cannot be
+    # driven or trusted.
+    "KF_SERVE_PORT",
+    "KF_SERVE_MAX_BATCH",
+    "KF_KV_BLOCK_TOKENS",
+    "KF_SLO_P99_MS",
+    "KF_SERVE_QUEUE",
+    "KF_SERVE_LEASE_MS",
+    # worker-side serving config: model family (validated against the
+    # size table at boot by serve.engine.build_lm), per-sequence token
+    # budget, pool-size override, drain target and iteration cap —
+    # forwarded so multi-host replicas boot with the same tier shape
+    # the operator configured (local spawns inherit os.environ and
+    # would hide the gap)
+    "KF_SERVE_MODEL",
+    "KF_SERVE_MAX_LEN",
+    "KF_SERVE_BLOCKS",
+    "KF_SERVE_EXPECT",
+    "KF_SERVE_MAX_ITERS",
 )
 
 ALL_BOOTSTRAP_VARS = (
@@ -112,6 +137,27 @@ def env_float(name: str, default: float,
             f"({default})") from None
     if math.isnan(v):
         raise ValueError(f"{name}={raw!r} is NaN")
+    if minimum is not None and v < minimum:
+        raise ValueError(f"{name}={raw!r} must be >= {minimum}")
+    return v
+
+
+def env_int(name: str, default: int,
+            environ: Optional[Dict[str, str]] = None,
+            minimum: Optional[int] = None) -> int:
+    """Parse an integer KF_* tuning variable with the same loud-at-
+    parse-time contract as :func:`env_float`; a fractional value
+    (``KF_SERVE_MAX_BATCH=2.5``) is an error, not a truncation."""
+    e = os.environ if environ is None else environ
+    raw = e.get(name, "")
+    if raw == "":
+        return default
+    try:
+        v = int(raw, 10)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer; unset it for the "
+            f"default ({default})") from None
     if minimum is not None and v < minimum:
         raise ValueError(f"{name}={raw!r} must be >= {minimum}")
     return v
@@ -190,6 +236,19 @@ def from_env(environ: Optional[Dict[str, str]] = None) -> Config:
     env_flag("KF_SHM_SWEEP", True, e)
     env_flag("KF_SHM_INJECT_CORRUPT", False, e)
     env_flag("KF_SHM_INJECT_ATTACH_FAIL", False, e)
+    # serving knobs (docs/serving.md): validated here so a garbage
+    # value fails at worker bootstrap with a named error instead of
+    # a decode tier quietly sized wrong
+    env_int("KF_SERVE_PORT", 0, e, minimum=0)
+    env_int("KF_SERVE_MAX_BATCH", 8, e, minimum=1)
+    env_int("KF_KV_BLOCK_TOKENS", 16, e, minimum=1)
+    env_float("KF_SLO_P99_MS", 0.0, e, minimum=0.0)
+    env_int("KF_SERVE_QUEUE", 256, e, minimum=1)
+    env_float("KF_SERVE_LEASE_MS", 10_000.0, e, minimum=100.0)
+    env_int("KF_SERVE_MAX_LEN", 64, e, minimum=2)
+    env_int("KF_SERVE_BLOCKS", 0, e, minimum=0)
+    env_int("KF_SERVE_EXPECT", 0, e, minimum=0)
+    env_int("KF_SERVE_MAX_ITERS", 20_000, e, minimum=1)
     self_spec = e.get(SELF_SPEC, "")
     if not self_spec:
         solo = PeerID.from_host("127.0.0.1", 0)

@@ -28,7 +28,8 @@ from .expert import (MoEParams, dispatch_tensors, init_moe_params,
 from .pipeline import (pipeline_apply, pipeline_train_step_1f1b,
                        stack_stage_params)
 from .rules import (PlanError, RuleTable, bert_tp_rules, gpt_moe_rules,
-                    gpt_pp_rules, gpt_tp_rules, match_partition_rules,
+                    gpt_pp_rules, gpt_serve_rules, gpt_tp_rules,
+                    match_partition_rules,
                     moe_ep_rules, reshard, seq_sp_rules, shard_params,
                     spec_diff, tree_specs)
 from .vocab_ce import vocab_sharded_fused_ce
@@ -64,6 +65,7 @@ __all__ = [
     "gpt_tp_rules",
     "gpt_moe_rules",
     "gpt_pp_rules",
+    "gpt_serve_rules",
     "moe_ep_rules",
     "seq_sp_rules",
     "match_partition_rules",

@@ -470,6 +470,24 @@ def gpt_pp_rules(axis: str = "pipe",
         batch_axes=())
 
 
+def gpt_serve_rules(axis: str = "model") -> RuleTable:
+    """The decode tier's placement (docs/serving.md): the Megatron
+    block split — GSPMD propagates the head sharding into the KV
+    tensors and inserts the ICI collectives, the standard TPU serving
+    layout — with embeddings/logits head replicated via the
+    catch-all (serving vocab sizes rarely divide a model axis, and
+    decode reads the whole head every token anyway). A table of its
+    own, not an alias of ``gpt_tp``: training and serving layouts
+    evolve independently (serving has no optimizer tree, and a future
+    KV-sharded layout lands HERE), and registering it keeps the
+    shard-rule-coverage/mesh passes gating the serving plan like
+    every other family's."""
+    return RuleTable(
+        name=f"gpt_serve[{axis}]",
+        rules=_megatron_rules("Block", axis) + (_CATCH_ALL,),
+        batch_axes=("data",))
+
+
 def moe_ep_rules(axis: str = "expert") -> RuleTable:
     """Expert-parallel placement of `parallel.expert.MoEParams`
     global views: expert stacks split their leading expert dim over
@@ -640,6 +658,12 @@ def _register_builtin_tables() -> None:
              # the dp x tp x pp family ROADMAP item 3 names
              [{"data": 2, "model": 2, "pipe": 2},
               {"model": 2, "pipe": 2}])
+    register("gpt_serve", gpt_serve_rules(),
+             _template_gpt,
+             # decode's (1, tp) serving mesh and the dp-replicated
+             # serving family (kungfu_tpu/serve, benchmarks/lm.py
+             # --decode --tp)
+             [{"data": 1, "model": 2}, {"data": 2, "model": 2}])
 
 
 _register_builtin_tables()
@@ -661,7 +685,8 @@ def _table_universe(table: RuleTable) -> Tuple[str, ...]:
 TABLE_AXES: Dict[str, Tuple[str, ...]] = {
     f.__name__: _table_universe(f())
     for f in (bert_tp_rules, gpt_tp_rules, gpt_moe_rules,
-              gpt_pp_rules, moe_ep_rules, seq_sp_rules)
+              gpt_pp_rules, moe_ep_rules, seq_sp_rules,
+              gpt_serve_rules)
 }
 
 

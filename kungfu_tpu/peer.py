@@ -83,6 +83,27 @@ def put_url(url: str, body: str, timeout: float = 5.0,
     retry.run(_put)
 
 
+def post_url(url: str, body: str, timeout: float = 5.0,
+             retry: Optional[retrying.RetryPolicy] = None) -> str:
+    """POST a JSON body, returning the response text — the serve
+    front-end's ingest verb (kungfu_tpu/serve/frontend.py). Same
+    shared retry policy as fetch_url/put_url: transient faults
+    (incl. 429 admission backpressure) back off and retry, permanent
+    ones (400 malformed submit) raise immediately."""
+    if retry is None:
+        retry = retrying.control_plane_policy(name=f"POST {url}")
+
+    def _post() -> str:
+        req = urllib.request.Request(
+            url, data=body.encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read().decode()
+
+    return retry.run(_post)
+
+
 class Peer:
     """One worker's control-plane endpoint.
 

@@ -85,6 +85,10 @@ class ScenarioPlan:
     # multi-host replays: "127.0.0.1:a,127.0.0.2:b" (one kfrun per
     # listed ip at replay time); "" = the single-runner launch
     hosts: str = ""
+    # what the cluster runs under the churn: "train" (continuity
+    # trainer) or "serve" (kfserve decode tier; steps are decode
+    # iterations and the replay gates on the request ledger)
+    workload: str = "train"
 
 
 def _size_timeline(scenario: Scenario) -> List[Tuple[int, int]]:
@@ -301,4 +305,5 @@ def compile_scenario(scenario) -> ScenarioPlan:
         description=scenario.description,
         notes=tuple(notes),
         hosts=_host_spec(scenario),
+        workload=scenario.workload,
     )

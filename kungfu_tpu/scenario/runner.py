@@ -111,6 +111,63 @@ def _phase_markers(plan: ScenarioPlan, phase, is_last: bool
     return markers
 
 
+def _run_serve_scenario(plan: ScenarioPlan, *, trace_dir: str,
+                        logdir: Optional[str],
+                        port_range: str, timeout: int,
+                        extra_env: Optional[Dict[str, str]]
+                        ) -> ScenarioRun:
+    """Replay a workload="serve" plan through the kfserve harness:
+    same compiled artifacts (schedule string, chaos schedule, env
+    arming), but the cluster runs decode workers against live
+    requests and the gate is the request ledger — every submitted
+    request completes, zero invariant violations — instead of loss
+    continuity. Single-phase by construction (spec.py refuses
+    cluster/host preempts under workload serve)."""
+    from ..serve.harness import SERVE_MARKERS, default_requests
+    from ..serve.harness import run_serve_cluster
+
+    assert len(plan.phases) == 1, plan
+    phase = plan.phases[0]
+    os.makedirs(trace_dir, exist_ok=True)
+    env = {
+        "KF_TRACE": "1",
+        "KF_TRACE_DIR": trace_dir,
+        "KF_CHAOS": (json.dumps(phase.chaos) if phase.chaos else ""),
+        "KF_CHAOS_FILE": "",
+        **phase.env,
+        **(extra_env or {}),
+    }
+    faults = (phase.chaos or {}).get("faults", [])
+    markers = SERVE_MARKERS
+    if any(f.get("type") in _WORKER_FAULTS for f in faults):
+        markers = markers + (
+            ("KF_CHAOS_FIRE", "a scheduled worker fault never fired"),)
+    t0 = time.perf_counter()
+    out = run_serve_cluster(
+        # enough in-flight tokens that the scheduled churn lands
+        # mid-request (the gate below is completion, not timing)
+        default_requests(5 * phase.np0, gen_len=48),
+        schedule=phase.schedule,
+        start_np=phase.np0,
+        port_range=port_range,
+        timeout=timeout,
+        logdir=logdir,
+        markers=markers,
+        extra_env=env,
+        recover=plan.needs_recover,
+    )
+    wall = time.perf_counter() - t0
+    return ScenarioRun(
+        plan=plan,
+        trace_dir=trace_dir,
+        ckpt_dir="",
+        phase_logs=(out["logs"],),
+        phase_wall_s=(round(out["wall_s"], 3),),
+        wall_s=round(wall, 3),
+        relaunch_gap_s=0.0,
+    )
+
+
 def run_scenario(scenario, *, trace_dir: str,
                  ckpt_dir: str = "",
                  logdir: Optional[str] = None,
@@ -134,6 +191,11 @@ def run_scenario(scenario, *, trace_dir: str,
             f"scenario {plan.name!r} carries netns partition windows "
             "— replay it through the chaos matrix (scripts/chaos.sh, "
             "FakeNet), not the loopback runner")
+    if plan.workload == "serve":
+        return _run_serve_scenario(
+            plan, trace_dir=trace_dir, logdir=logdir,
+            port_range=port_range, timeout=timeout,
+            extra_env=extra_env)
 
     os.makedirs(trace_dir, exist_ok=True)
     if plan.needs_ckpt and not ckpt_dir:

@@ -90,7 +90,14 @@ class Scenario:
     counts, in host-index order (``[2, 2]`` = two hosts of two slots —
     loopback aliases 127.0.0.1 + 127.0.0.2 at replay time). Empty =
     one host, the pre-existing single-runner shape. Host-scoped
-    preempt events index into this list."""
+    preempt events index into this list.
+
+    ``workload`` selects what the cluster RUNS under the churn:
+    ``"train"`` (default — the continuity trainer every pre-existing
+    scenario replays) or ``"serve"`` (the kfserve decode tier,
+    docs/serving.md: the replay submits live requests and gates on
+    every one completing + the request-ledger invariants; a step is
+    one decode iteration)."""
 
     name: str
     np0: int
@@ -101,6 +108,7 @@ class Scenario:
     env: Dict[str, str] = field(default_factory=dict)
     description: str = ""
     hosts: List[int] = field(default_factory=list)
+    workload: str = "train"
 
     def to_json(self) -> str:
         return json.dumps({
@@ -108,6 +116,7 @@ class Scenario:
             "events": self.events, "device_batch": self.device_batch,
             "seed": self.seed, "env": self.env,
             "description": self.description, "hosts": self.hosts,
+            "workload": self.workload,
         }, sort_keys=True)
 
 
@@ -202,6 +211,30 @@ def load_scenario(spec) -> Scenario:
             isinstance(k, str) and isinstance(v, str)
             for k, v in env.items()):
         raise ValueError(f"scenario {name!r}: 'env' must map str->str")
+    workload = str(spec.get("workload", "train"))
+    if workload not in ("train", "serve"):
+        raise ValueError(
+            f"scenario {name!r}: unknown workload {workload!r} "
+            "(known: train, serve)")
+    if workload == "serve":
+        # the serve replay is single-phase (the request ledger lives
+        # in the replay process's config server): churn the decode
+        # tier survives is in scope, churn that takes the control
+        # plane with it is a different scenario
+        for n, ev in enumerate(events):
+            kind = ev.get("kind")
+            if kind == "partition":
+                continue  # refused at replay time like train's
+            if kind == "preempt" and (
+                    ev.get("rank") is None
+                    or ev.get("scope") == "cluster"
+                    or ev.get("host") is not None):
+                raise ValueError(
+                    f"scenario {name!r}: workload 'serve' supports "
+                    f"rank-scoped preempts only (event {n} is "
+                    "cluster/host-scoped: a whole-allocation serving "
+                    "preemption needs a ledger-relaunch story that "
+                    "is not modeled yet)")
     return Scenario(
         name=name, np0=np0, steps=steps,
         events=[dict(e) for e in events],
@@ -210,6 +243,7 @@ def load_scenario(spec) -> Scenario:
         env=dict(env),
         description=str(spec.get("description", "")),
         hosts=[int(h) for h in hosts],
+        workload=workload,
     )
 
 
@@ -271,6 +305,31 @@ def spot_host_kill(np0: int = 4) -> Scenario:
                        "(1-step warning): every rank on host 1 dies "
                        "at once; survivor recovery + schedule-driven "
                        "re-grow onto the reclaimed host",
+    })
+
+
+def spot_serve_kill(np0: int = 2) -> Scenario:
+    """Spot-preempt one DECODE worker mid-request (workload: serve,
+    docs/serving.md): the victim's leased requests outlive it on the
+    config server's ledger, survivors ride the recovery path, the
+    schedule re-grows the tier, and the resumed leases finish every
+    request — the serving analog of `spot_kill_regrow`, gated on the
+    request-ledger invariants instead of loss continuity. Steps are
+    decode iterations (fast next to train steps, hence the longer
+    timeline)."""
+    return load_scenario({
+        "name": "spot_serve_kill", "np0": np0, "steps": 400,
+        "workload": "serve",
+        "events": [
+            {"kind": "preempt", "step": 8, "rank": np0 - 1,
+             "lead_steps": 1},
+        ],
+        "env": {"KF_SERVE_MAX_BATCH": "4",
+                "KF_SERVE_LEASE_MS": "3000"},
+        "description": "spot-preempt decode worker np0-1 at iteration "
+                       "8 mid-request; lease expiry resumes its "
+                       "requests on survivors, schedule re-grows the "
+                       "tier, every request completes",
     })
 
 
@@ -347,6 +406,7 @@ CANNED = {
     "spot_preempt": spot_preempt,
     "spot_kill_regrow": spot_kill_regrow,
     "spot_host_kill": spot_host_kill,
+    "spot_serve_kill": spot_serve_kill,
     "diurnal": diurnal,
     "straggler_transient": straggler_transient,
     "flaky_control": flaky_control,

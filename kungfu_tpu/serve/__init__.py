@@ -1,0 +1,31 @@
+"""kfserve: the elastic continuous-batching decode tier.
+
+The "millions of users" half of the north star (ROADMAP item 1):
+decode stops being a benchmark row and becomes a serving subsystem —
+a request front-end riding the config-server control plane
+(`serve.ledger` + the `/serve/*` routes), an iteration-level
+continuous-batching scheduler over a block-table paged KV cache
+(`serve.engine` / `serve.kv_cache` / `serve.paged` — Orca's
+iteration-level admission + vLLM's PagedAttention, PAPERS.md), and —
+the piece neither has — *elastic* serving: decode workers ride the
+SAME versioned-epoch membership machinery training uses (consensus
+resize, survivor recovery, cold boot from the sharded checkpoint
+tier), sized by a queue-depth/latency policy
+(`elastic.policy.SLOPolicy`). docs/serving.md is the architecture
+document.
+"""
+
+from .engine import SIZES, DecodeEngine, build_lm
+from .kv_cache import KVPoolExhausted, PagedKVPool
+from .ledger import AdmissionFull, Request, RequestLedger
+
+__all__ = [
+    "AdmissionFull",
+    "DecodeEngine",
+    "KVPoolExhausted",
+    "PagedKVPool",
+    "Request",
+    "RequestLedger",
+    "SIZES",
+    "build_lm",
+]

@@ -1,0 +1,316 @@
+"""The continuous-batching decode engine: Orca's iteration-level loop.
+
+One `DecodeEngine` owns the model params, the paged KV pool and the
+jitted decode step, and exposes exactly two scheduling verbs:
+
+- ``admit(seq_id, prompt, max_new)`` — prefill a new request into a
+  free batch slot (one batched causal forward through the MODEL's own
+  prefill path fills the sequence's pool blocks) and emit its first
+  token;
+- ``step()`` — ONE decode iteration for every live slot, whatever
+  mix of requests currently occupies them. New requests join the
+  running batch between iterations (iteration-level scheduling,
+  PAPERS.md Orca), finished requests retire and their blocks return
+  to the pool immediately — no batch drains, no padding to the
+  longest request.
+
+When the pool runs dry mid-decode the engine PREEMPTS the youngest
+sequence (fewest generated tokens — the cheapest redo) instead of
+corrupting a live block: `step()` reports it and the caller returns
+the request to the ledger, where its generated-so-far tokens are
+already recorded and a later admission resumes it by re-prefilling
+prompt + generated (docs/serving.md, "KV block lifecycle").
+
+`build_lm` is the ONE model/params(+tp-sharding) setup both this
+engine and `benchmarks/lm.py --decode` call, so the published
+`gpt_decode_tokens_per_sec` row and the serving tier cannot drift
+apart. Sampling is greedy (argmax) throughout — serving determinism
+is what the parity tests pin.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .. import trace
+from .kv_cache import KVPoolExhausted, PagedKVPool, pool_capacity_blocks
+
+SIZES = {
+    # name -> (hidden, layers, heads, intermediate); the canonical
+    # GPT size table (benchmarks/lm.py re-exports it)
+    "tiny": (128, 2, 8, 256),
+    "small": (768, 12, 12, 3072),   # GPT-2 124M
+    "medium": (1024, 24, 16, 4096),  # GPT-2 350M
+}
+
+
+def build_lm(size: str, max_position: int, tp: int = 1, dtype=None,
+             seed: int = 0, vocab_size: int = 50257):
+    """Model + params (+ tp sharding) for decoding: the shared setup
+    of `benchmarks.lm.measure_decode_rate` and `DecodeEngine`.
+
+    Returns ``(model, params, mesh)`` — `mesh` is None at tp=1,
+    otherwise the (1, tp) ("data", "model") mesh with the params
+    Megatron-sharded per the `serve` rules table
+    (`parallel.rules.gpt_serve_rules` — registered, so the
+    shard-rule-coverage/mesh lint passes gate serving's plan like
+    every other family's). Raises SystemExit with the same messages
+    the benchmark always printed for impossible tp splits.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import GPTConfig, GPTLM
+
+    if size not in SIZES:
+        raise SystemExit(f"unknown size {size!r} (known: {sorted(SIZES)})")
+    hidden, layers, heads, inter = SIZES[size]
+    n = jax.device_count()
+    if tp > n:
+        raise SystemExit(f"--tp {tp} exceeds device count {n}")
+    if heads % tp:
+        raise SystemExit(
+            f"--tp {tp} must divide num_heads {heads} of size={size}")
+    cfg = GPTConfig(vocab_size=vocab_size, hidden_size=hidden,
+                    num_layers=layers, num_heads=heads,
+                    intermediate_size=inter,
+                    max_position=max_position,
+                    dtype=dtype if dtype is not None else jnp.bfloat16)
+    model = GPTLM(cfg)
+    probe = jnp.zeros((1, 1), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), probe)["params"]
+    mesh = None
+    if tp > 1:
+        from jax.sharding import Mesh
+
+        from ..parallel.rules import gpt_serve_rules, shard_params
+
+        # decode's mesh is (1, tp) over the first tp devices — the
+        # standard TPU serving layout (GSPMD propagates the Megatron
+        # head sharding into the KV caches and inserts the ICI
+        # collectives)
+        mesh = Mesh(np.array(jax.devices()[:tp]).reshape(1, tp),
+                    ("data", "model"))
+        params = shard_params(jax.device_get(params), mesh,
+                              gpt_serve_rules())
+    return model, params, mesh
+
+
+@dataclass
+class _Seq:
+    """One live sequence's engine-side state."""
+
+    slot: int
+    prompt_len: int
+    max_new: int
+    cache_len: int                    # tokens currently in pool blocks
+    last_token: int                   # next decode input
+    generated: List[int] = field(default_factory=list)
+
+
+class DecodeEngine:
+    """Iteration-level continuous batching over the paged KV pool."""
+
+    def __init__(self, model, params, max_batch: int,
+                 block_tokens: int, max_len: int,
+                 num_blocks: int = 0, eos: Optional[int] = None):
+        from . import paged
+
+        cfg = model.config
+        if max_len > cfg.max_position:
+            raise ValueError(
+                f"max_len {max_len} exceeds the model's max_position "
+                f"{cfg.max_position}")
+        if max_batch <= 0:
+            raise ValueError(f"max_batch must be positive, got "
+                             f"{max_batch}")
+        self.model = model
+        self.cfg = cfg
+        self.params = params
+        self.max_batch = int(max_batch)
+        self.max_len = int(max_len)
+        self.eos = eos
+        self.max_blocks = paged.max_blocks_for(max_len, block_tokens)
+        num_blocks = num_blocks or pool_capacity_blocks(
+            max_batch, max_len, block_tokens)
+        self.pool = PagedKVPool(num_blocks, block_tokens)
+        self.pool_k, self.pool_v = paged.init_pool_tensors(
+            cfg, num_blocks, block_tokens)
+        self._decode = paged.make_decode_fn(cfg)
+        self._slots: List[Optional[object]] = [None] * self.max_batch
+        self._seqs: Dict[object, _Seq] = {}
+        self.steps = 0
+
+    # -- admission ----------------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        return len(self._seqs)
+
+    def free_slots(self) -> int:
+        return self.max_batch - len(self._seqs)
+
+    def can_admit(self, prompt_len: int) -> bool:
+        return (self.free_slots() > 0
+                and prompt_len < self.max_len
+                and self.pool.can_admit(prompt_len))
+
+    def admit(self, seq_id, prompt: List[int],
+              max_new: int) -> Tuple[int, bool]:
+        """Prefill `prompt` into a free slot; returns ``(first_token,
+        done)``. Raises KVPoolExhausted / ValueError when it cannot —
+        the caller's admission queue keeps the request."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from . import paged
+
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id!r} already live")
+        if self.free_slots() <= 0:
+            raise KVPoolExhausted("no free batch slot")
+        t = len(prompt)
+        if not 0 < t < self.max_len:
+            raise ValueError(
+                f"prompt length {t} outside (0, {self.max_len})")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        table = self.pool.admit(seq_id, t)
+        bt = self.pool.block_tokens
+        # pad the prompt to a block-sized bucket: one prefill compile
+        # per bucket instead of per distinct length (causal masking
+        # keeps every real position independent of the padding)
+        padded = -(-t // bt) * bt
+        arr = np.zeros((1, padded), np.int32)
+        arr[0, :t] = prompt
+        with trace.span("request.prefill", cat="serve", seq=str(seq_id),
+                        prompt_len=t):
+            logits, ks, vs = paged.prefill(self.model, self.params,
+                                           jnp.asarray(arr))
+            # the full padded prefix ships to the pool in ONE donated
+            # scatter (padded tail masked by length, never visible)
+            self.pool_k, self.pool_v = paged.write_prefill(
+                self.pool_k, self.pool_v, table,
+                ks[:, 0], vs[:, 0], bt)
+            tok0 = int(jnp.argmax(logits[0, t - 1]))
+        slot = self._slots.index(None)
+        seq = _Seq(slot=slot, prompt_len=t, max_new=int(max_new),
+                   cache_len=t, last_token=tok0, generated=[tok0])
+        done = self._finished(seq)
+        if done:
+            self.pool.release(seq_id)
+        else:
+            self._slots[slot] = seq_id
+            self._seqs[seq_id] = seq
+        return tok0, done
+
+    def _finished(self, seq: _Seq) -> bool:
+        if len(seq.generated) >= seq.max_new:
+            return True
+        if self.eos is not None and seq.generated[-1] == self.eos:
+            return True
+        # hard cap: the pool reservation ends at max_len positions
+        return seq.cache_len + 1 >= self.max_len
+
+    # -- the iteration ------------------------------------------------------
+
+    def _make_room(self, seq_id) -> List[object]:
+        """Extend `seq_id`'s table by one position, preempting the
+        youngest OTHER live sequence (fewest generated tokens) until
+        it fits; preempting `seq_id` itself is the last resort.
+        Returns the preempted ids."""
+        preempted: List[object] = []
+        while True:
+            try:
+                self.pool.grow(
+                    seq_id, self._seqs[seq_id].cache_len + 1)
+                return preempted
+            except KVPoolExhausted:
+                victims = sorted(
+                    self._seqs,
+                    key=lambda s: (s == seq_id,
+                                   len(self._seqs[s].generated)))
+                victim = victims[0]
+                self._drop(victim)
+                preempted.append(victim)
+                if victim == seq_id:
+                    return preempted
+
+    def _drop(self, seq_id) -> None:
+        seq = self._seqs.pop(seq_id)
+        self._slots[seq.slot] = None
+        self.pool.release(seq_id)
+
+    def step(self) -> Tuple[Dict[object, Tuple[int, bool]],
+                            List[object]]:
+        """One decode iteration over every live slot.
+
+        Returns ``(emitted, preempted)``: `emitted` maps seq_id ->
+        (token, done) for every sequence that decoded this iteration;
+        `preempted` lists sequences evicted by pool pressure (their
+        blocks are freed; re-admit to resume). No live slots -> both
+        empty.
+        """
+        import numpy as np
+
+        if not self._seqs:
+            return {}, []
+        # capacity first: every row's incoming token needs a slot in
+        # its block table BEFORE the batched scatter runs
+        preempted: List[object] = []
+        for seq_id in [s for s in self._slots if s is not None]:
+            if seq_id in self._seqs:  # not preempted by an earlier row
+                preempted.extend(self._make_room(seq_id))
+        live = [s for s in self._slots if s is not None]
+        if not live:
+            return {}, preempted
+        order = {s: self._seqs[s].slot for s in live}
+        tokens = np.zeros(self.max_batch, np.int32)
+        lengths = np.zeros(self.max_batch, np.int32)
+        tables = self.pool.batch_tables([], self.max_blocks,
+                                        pad_rows=self.max_batch)
+        for s, slot in order.items():
+            seq = self._seqs[s]
+            tokens[slot] = seq.last_token
+            lengths[slot] = seq.cache_len
+            row = self.pool.table(s)
+            tables[slot, :len(row)] = row
+        with trace.span("serve.decode_step", cat="serve",
+                        batch=len(live)):
+            logits, self.pool_k, self.pool_v = self._decode(
+                self.params, self.pool_k, self.pool_v, tables,
+                lengths, tokens)
+            toks = np.asarray(logits.argmax(axis=-1))
+        emitted: Dict[object, Tuple[int, bool]] = {}
+        for s, slot in order.items():
+            seq = self._seqs[s]
+            tok = int(toks[slot])
+            seq.generated.append(tok)
+            seq.last_token = tok
+            seq.cache_len += 1
+            done = self._finished(seq)
+            if done:
+                self._drop(s)
+            emitted[s] = (tok, done)
+        self.steps += 1
+        return emitted, preempted
+
+    def drain(self, seq_id) -> None:
+        """Release a live sequence without finishing it (eviction /
+        shutdown: its blocks return to the pool; the ledger keeps the
+        generated-so-far record)."""
+        if seq_id in self._seqs:
+            self._drop(seq_id)
+
+    def live(self) -> List[object]:
+        return [s for s in self._slots if s is not None]
+
+    def is_live(self, seq_id) -> bool:
+        return seq_id in self._seqs
+
+    def generated(self, seq_id) -> List[int]:
+        return list(self._seqs[seq_id].generated)

@@ -1,0 +1,207 @@
+"""Block-table paged KV cache: fixed-size blocks in a preallocated pool.
+
+The vLLM PagedAttention idea (PAPERS.md), sized for this runtime: the
+KV cache is ONE preallocated pool of fixed-size blocks
+(`KF_KV_BLOCK_TOKENS` tokens each) shared by every sequence in the
+decode batch, so sequences of wildly different lengths batch together
+without reserving max_position tokens each — the reservation that
+makes dense [B, max_position] caches cap batch size at the longest
+request. A sequence owns an ordered list of block ids (its *block
+table*); allocation appends a block when the sequence crosses a block
+boundary, retirement returns every block to the free list for the
+next admission to reuse.
+
+Two halves, split on purpose:
+
+- the **allocator** (this module) is host-side, pure-Python, and
+  schedule-only — no tensor reads — so its invariants (every block
+  owned by at most one sequence, free+owned == capacity, reuse is
+  LIFO) are testable without JAX and auditable by eye;
+- the **pool tensors** (`k`/`v`, [layers, blocks, block_tokens,
+  heads, head_dim]) live wherever JAX puts them and are only touched
+  by `serve.paged`'s gather/scatter decode step.
+
+Block 0 is a reserved SCRATCH block, never allocated: inactive batch
+rows point their table at it so the (always-batched) scatter of the
+current token's k/v has somewhere harmless to land — no real
+sequence ever reads it (visibility is masked by length).
+
+Cross-request isolation does not depend on zeroing freed blocks:
+attention masks every position >= the sequence's own length, so a
+reused block's stale bytes are never visible. The
+`test_no_cross_request_leakage` fixture in tests/test_serve.py pins
+exactly that (reused-pool logits bitwise == fresh-pool logits).
+
+`kf_kv_blocks_in_use` (gauge, docs/observability.md) tracks pool
+pressure — the admission-control signal `SLOPolicy` and operators
+watch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..trace import metrics
+
+#: reserved scratch block id (see module docstring)
+SCRATCH_BLOCK = 0
+
+
+class KVPoolExhausted(RuntimeError):
+    """No free KV blocks: the admission signal — the scheduler must
+    stop admitting (or evict) instead of corrupting a live block."""
+
+
+class PagedKVPool:
+    """Fixed-size-block KV pool + per-sequence block tables.
+
+    `num_blocks` counts usable blocks EXCLUDING the scratch block;
+    capacity in tokens is ``num_blocks * block_tokens``. Pool tensors
+    are created lazily by `serve.paged.init_pool_tensors` (the
+    allocator stays importable without JAX).
+    """
+
+    def __init__(self, num_blocks: int, block_tokens: int):
+        if num_blocks <= 0 or block_tokens <= 0:
+            raise ValueError(
+                f"need positive num_blocks/block_tokens, got "
+                f"{num_blocks}/{block_tokens}")
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        # LIFO free list (ids 1..num_blocks; 0 is scratch): reuse the
+        # most-recently-freed block first, so leakage-after-eviction
+        # bugs surface on the very next admission instead of hiding
+        # behind a cold tail of never-touched blocks
+        self._free: List[int] = list(range(self.num_blocks, 0, -1))
+        self._tables: Dict[object, List[int]] = {}
+        self._lengths: Dict[object, int] = {}
+        self._publish()
+
+    # -- allocator ----------------------------------------------------------
+
+    def _publish(self) -> None:
+        metrics.REGISTRY.set("kf_kv_blocks_in_use",
+                             self.num_blocks - len(self._free))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to hold `tokens` positions."""
+        return -(-max(tokens, 0) // self.block_tokens)
+
+    def can_admit(self, tokens: int) -> bool:
+        return self.blocks_for(tokens) <= len(self._free)
+
+    def admit(self, seq, tokens: int) -> List[int]:
+        """Register sequence `seq` at length `tokens`, allocating its
+        initial block table. Raises KVPoolExhausted (allocating
+        nothing) when the pool cannot hold it."""
+        if seq in self._tables:
+            raise ValueError(f"sequence {seq!r} already admitted")
+        need = self.blocks_for(max(tokens, 1))
+        if need > len(self._free):
+            raise KVPoolExhausted(
+                f"seq {seq!r} needs {need} blocks, {len(self._free)} "
+                f"free of {self.num_blocks}")
+        self._tables[seq] = [self._free.pop() for _ in range(need)]
+        self._lengths[seq] = int(tokens)
+        self._publish()
+        return list(self._tables[seq])
+
+    def grow(self, seq, new_length: int) -> None:
+        """Grow `seq`'s table to cover `new_length` tokens (decode
+        appends one token per step; the table grows only at block
+        boundaries). Raises KVPoolExhausted with the table unchanged
+        when the pool is dry — the caller decides eviction policy."""
+        table = self._tables[seq]
+        need = self.blocks_for(new_length) - len(table)
+        if need > len(self._free):
+            raise KVPoolExhausted(
+                f"seq {seq!r} needs {need} more block(s), "
+                f"{len(self._free)} free")
+        for _ in range(max(need, 0)):
+            table.append(self._free.pop())
+        self._lengths[seq] = int(new_length)
+        self._publish()
+
+    def release(self, seq) -> None:
+        """Retire `seq`: every owned block returns to the free list."""
+        for b in reversed(self._tables.pop(seq)):
+            self._free.append(b)
+        del self._lengths[seq]
+        self._publish()
+
+    def length(self, seq) -> int:
+        return self._lengths[seq]
+
+    def table(self, seq) -> List[int]:
+        return list(self._tables[seq])
+
+    def sequences(self):
+        return list(self._tables)
+
+    def check_invariants(self) -> List[str]:
+        """Allocator health: disjoint ownership, conservation, table
+        sizes consistent with lengths. Empty list == healthy (the
+        serve smoke and tests gate on it)."""
+        out: List[str] = []
+        owned = [b for t in self._tables.values() for b in t]
+        if len(owned) != len(set(owned)):
+            out.append("a block is owned by two sequences")
+        if SCRATCH_BLOCK in owned or SCRATCH_BLOCK in self._free:
+            out.append("scratch block 0 entered circulation")
+        if sorted(owned + self._free) != list(
+                range(1, self.num_blocks + 1)):
+            out.append(
+                f"conservation violated: {len(owned)} owned + "
+                f"{len(self._free)} free != {self.num_blocks}")
+        for seq, t in self._tables.items():
+            if len(t) != self.blocks_for(max(self._lengths[seq], 1)):
+                out.append(f"seq {seq!r}: table {len(t)} blocks vs "
+                           f"length {self._lengths[seq]}")
+        return out
+
+    # -- batch views (consumed by serve.paged) ------------------------------
+
+    def batch_tables(self, seqs, max_blocks: int,
+                     pad_rows: int = 0):
+        """[len(seqs)+pad_rows, max_blocks] int32 block-table matrix;
+        unused entries (and every entry of a pad row) point at the
+        scratch block. `max_blocks` must cover the longest table."""
+        import numpy as np
+
+        rows = len(seqs) + pad_rows
+        out = np.full((rows, max_blocks), SCRATCH_BLOCK, np.int32)
+        for i, seq in enumerate(seqs):
+            t = self._tables[seq]
+            if len(t) > max_blocks:
+                raise ValueError(
+                    f"seq {seq!r} table {len(t)} > max_blocks "
+                    f"{max_blocks}")
+            out[i, :len(t)] = t
+        return out
+
+    def batch_lengths(self, seqs, pad_rows: int = 0):
+        """[len(seqs)+pad_rows] int32 lengths; pad rows are 0."""
+        import numpy as np
+
+        out = np.zeros(len(seqs) + pad_rows, np.int32)
+        for i, seq in enumerate(seqs):
+            out[i] = self._lengths[seq]
+        return out
+
+
+def pool_capacity_blocks(max_batch: int, max_len: int,
+                         block_tokens: int,
+                         headroom_blocks: int = 0) -> int:
+    """Blocks needed for `max_batch` concurrent sequences of up to
+    `max_len` tokens — the engine's default preallocation sizing
+    (callers shrink it to create admission pressure in tests)."""
+    per_seq = -(-max_len // block_tokens)
+    return max_batch * per_seq + headroom_blocks

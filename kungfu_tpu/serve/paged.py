@@ -1,0 +1,236 @@
+"""Paged-attention GPT forward: decode over a block-table KV pool.
+
+The dense decode path (`models.gpt.CausalSelfAttention`, decode=True)
+holds one [B, max_position, H, D] cache per layer with a SINGLE
+scalar cursor — every row of the batch must be at the same position,
+which is exactly what continuous batching breaks (each sequence in
+the batch is at its own length). This module is the paged replacement:
+
+- the KV cache is the `serve.kv_cache.PagedKVPool`'s tensors
+  ([layers, blocks, block_tokens, heads, head_dim]);
+- each decode step takes per-row block tables + lengths, scatters the
+  new token's k/v at each row's own (block, offset), gathers each
+  row's blocks back into a contiguous view, and masks attention to
+  the row's own visible prefix — vLLM's PagedAttention decode shape,
+  expressed in stock JAX gather/scatter (a Pallas kernel drops in
+  behind the same signature when a TPU session warrants it);
+- **prefill rides the model itself**: one batched causal forward via
+  the model's prefill path fills a dense per-layer cache (which on
+  TPU runs the flash VMEM-resident scheme when the config says
+  ``attention="flash"`` — the same kernel the training rows use), and
+  the filled prefix is copied into the sequence's pool blocks. Time
+  to first token is one forward, and serve-prefill numerics cannot
+  drift from the model's.
+
+Numerics follow the model's decode branch exactly: f32 scores/softmax
+with the config dtype everywhere else (the per-sequence-parity test
+in tests/test_serve.py pins token agreement against `gpt_generate`,
+and batch-composition bitwise parity against itself).
+
+Everything here is FUNCTIONAL: `decode_step` takes and returns the
+pool tensors (the engine jits it with the pools donated), and nothing
+reads clocks, env or the allocator — the host-side scheduling stays
+in `serve.engine` where the trace-purity lint can see it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _supported(cfg) -> None:
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "paged decode serves dense GPT configs; MoE decode routing "
+            "is not implemented")
+
+
+def init_pool_tensors(cfg, num_blocks: int, block_tokens: int):
+    """(k, v) pool tensors [L, num_blocks+1, block_tokens, H, D] in
+    the config dtype (+1: block 0 is the allocator's scratch block)."""
+    _supported(cfg)
+    h, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    shape = (cfg.num_layers, num_blocks + 1, block_tokens, h, d)
+    return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+# -- explicit-params module applications (gpt_pipeline_forward style) ---------
+
+
+def _dense(p, x, dtype):
+    return (x.astype(dtype) @ p["kernel"].astype(dtype)
+            + p["bias"].astype(dtype))
+
+
+def _qkv(p, x, dtype):
+    """DenseGeneral((heads, head_dim)): kernel [H, h, d], bias [h, d]."""
+    return (jnp.einsum("bh,hnd->bnd", x.astype(dtype),
+                       p["kernel"].astype(dtype))
+            + p["bias"].astype(dtype))
+
+
+def _attn_out(p, x, dtype):
+    """DenseGeneral(hidden, axis=(-2, -1)): kernel [h, d, H], bias [H]."""
+    return (jnp.einsum("bnd,ndh->bh", x.astype(dtype),
+                       p["kernel"].astype(dtype))
+            + p["bias"].astype(dtype))
+
+
+def _layernorm(p, x, dtype, eps: float = 1e-6):
+    """flax LayerNorm(dtype=cfg.dtype, param_dtype=f32): f32 stats,
+    f32 scale/bias, output in the compute dtype."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(dtype)
+
+
+def decode_step(cfg, params, pool_k, pool_v, tables, lengths, tokens):
+    """One continuous-batching decode iteration.
+
+    - `tables` [B, max_blocks] int32 — each row's block table (unused
+      entries point at the scratch block);
+    - `lengths` [B] int32 — tokens already in each row's cache; the
+      incoming token is written at position `lengths[b]` (inactive pad
+      rows carry length 0 and a scratch table — their writes land in
+      the scratch block and their outputs are ignored);
+    - `tokens` [B] int32 — each row's current input token.
+
+    Returns ``(logits [B, vocab] f32, pool_k, pool_v)``. Rows are
+    independent: a row's logits depend only on its own table/length/
+    token, which is what makes batch composition a scheduling choice
+    instead of a numerics choice (pinned bitwise by tests).
+    """
+    _supported(cfg)
+    dtype = cfg.dtype
+    bsz = tokens.shape[0]
+    max_blocks = tables.shape[1]
+    bt = pool_k.shape[2]
+    d = cfg.hidden_size // cfg.num_heads
+    rows = jnp.arange(bsz)
+    blk = tables[rows, lengths // bt]       # [B] destination block id
+    off = lengths % bt                      # [B] offset inside it
+    visible = (jnp.arange(max_blocks * bt)[None, :]
+               <= lengths[:, None])         # positions 0..length incl.
+
+    wte = params["wte"]["embedding"].astype(dtype)
+    wpe = params["wpe"]["embedding"].astype(dtype)
+    x = wte[tokens] + wpe[lengths]          # [B, H]
+    for layer in range(cfg.num_layers):
+        p = params[f"Block_{layer}"]
+        y = _layernorm(p["LayerNorm_0"], x, dtype)
+        a = p["CausalSelfAttention_0"]
+        q = _qkv(a["query"], y, dtype)      # [B, h, d]
+        k = _qkv(a["key"], y, dtype)
+        v = _qkv(a["value"], y, dtype)
+        pool_k = pool_k.at[layer, blk, off].set(k)
+        pool_v = pool_v.at[layer, blk, off].set(v)
+        # gather each row's blocks into its contiguous [T, h, d] view
+        kk = pool_k[layer][tables].reshape(bsz, max_blocks * bt,
+                                           cfg.num_heads, d)
+        vv = pool_v[layer][tables].reshape(bsz, max_blocks * bt,
+                                           cfg.num_heads, d)
+        # f32 scores/softmax — the model's decode-branch numerics
+        s = jnp.einsum("bnd,btnd->bnt", q.astype(jnp.float32),
+                       kk.astype(jnp.float32)) * (d ** -0.5)
+        s = jnp.where(visible[:, None, :], s,
+                      jnp.finfo(jnp.float32).min)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bnt,btnd->bnd", w,
+                       vv.astype(jnp.float32)).astype(dtype)
+        x = x + _attn_out(a["out"], o, dtype)
+        y = _layernorm(p["LayerNorm_1"], x, dtype)
+        y = _dense(p["Dense_0"], y, dtype)
+        y = jax.nn.gelu(y)
+        y = _dense(p["Dense_1"], y, dtype)
+        x = x + y
+    x = _layernorm(params["LayerNorm_0"], x, dtype)
+    logits = _dense(params["lm_head"], x, jnp.float32)
+    return logits, pool_k, pool_v
+
+
+def make_decode_fn(cfg):
+    """The jitted decode step for one engine: pools donated (the pool
+    is updated in place across iterations, never copied). The engine
+    always calls it at its full (max_batch, max_blocks) shapes, so
+    every iteration of the serving loop is ONE compiled program
+    regardless of which slots are live."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def fn(params, pool_k, pool_v, tables, lengths, tokens):
+        return decode_step(cfg, params, pool_k, pool_v, tables,
+                           lengths, tokens)
+
+    return fn
+
+
+def prefill(model, params, prompt):
+    """Batched causal prefill through the MODEL's own prefill path.
+
+    `prompt` [B, T] int32. Returns ``(logits [B, T, vocab] f32, ks,
+    vs)`` with ks/vs [L, B, T, h, d] — the filled cache prefix, ready
+    for `write_prefill` to scatter into pool blocks. One forward,
+    same numerics as `gpt_generate`'s prefill (it IS the same code
+    path). Callers that pad the prompt to a length bucket (the
+    engine does, to bound compile count) read the logits at the last
+    REAL position — causal masking keeps positions < T independent
+    of the padding behind them.
+    """
+    _supported(model.config)
+    cfg = model.config
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), prompt[:, :1],
+                           decode=True))
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, prompt, prefill=True,
+        mutable=["cache"])
+    t = prompt.shape[1]
+    ks = jnp.stack([
+        mut["cache"][f"Block_{i}"]["CausalSelfAttention_0"]["k"][:, :t]
+        for i in range(cfg.num_layers)])
+    vs = jnp.stack([
+        mut["cache"][f"Block_{i}"]["CausalSelfAttention_0"]["v"][:, :t]
+        for i in range(cfg.num_layers)])
+    return logits.astype(jnp.float32), ks, vs
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_blocks(pool_k, pool_v, ks, vs, blocks):
+    """One donated scatter of block-aligned K/V ([L, n*bt, h, d])
+    into pool blocks `blocks` [n] — NOT a Python loop of un-jitted
+    `.at[].set()` calls, each of which would copy the entire tier's
+    KV memory per block on the hot admission path."""
+    n = blocks.shape[0]
+    bt = pool_k.shape[2]
+    shape = (ks.shape[0], n, bt) + ks.shape[2:]
+    pool_k = pool_k.at[:, blocks].set(ks.reshape(shape))
+    pool_v = pool_v.at[:, blocks].set(vs.reshape(shape))
+    return pool_k, pool_v
+
+
+def write_prefill(pool_k, pool_v, table, ks, vs, block_tokens: int):
+    """Scatter one sequence's prefill K/V ([L, T_padded, h, d], padded
+    to the block-sized bucket so T_padded == len(table)*block_tokens)
+    into its block table (a host-side list of block ids). The padded
+    tail lands in owned blocks past the sequence's length — never
+    visible (attention masks by length), and it keeps the scatter one
+    jitted donated call per admission. Returns the updated pools."""
+    t = ks.shape[1]
+    if t != len(table) * block_tokens:
+        raise ValueError(
+            f"prefill K/V length {t} != {len(table)} blocks x "
+            f"{block_tokens} tokens — pad the prompt to its bucket")
+    blocks = np.asarray(table, np.int32)
+    return _scatter_blocks(pool_k, pool_v, ks, vs, blocks)
+
+
+def max_blocks_for(max_len: int, block_tokens: int) -> int:
+    """Block-table width covering `max_len` tokens."""
+    return int(np.ceil(max_len / block_tokens))

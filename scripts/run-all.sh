@@ -245,6 +245,28 @@ assert "size=4 step=12" in logs, logs[-2000:]
 print("MASTER-KILL HIER+SHM RECOVERY SMOKE OK")
 EOF
 
+echo "== [4h/7] serving smoke: 2-worker decode tier, mid-traffic grow 2->3 =="
+# the kfserve decode tier (docs/serving.md): a 2-replica continuous-
+# batching cluster serves a live request mix; once a quarter of it
+# completed the harness grows the tier 2->3 through the consensus-
+# resize path WHILE traffic is in flight (joiner adopts weights via
+# the boot broadcast, survivors' paged KV pools ride through), and
+# the run gates on every request completing + zero request-ledger
+# invariant violations — the request-plane analog of the --goodput
+# phase-sum gate.
+timeout 400 python - <<'EOF'
+from kungfu_tpu.serve.harness import (RESIZE_MARKERS, default_requests,
+                                      run_serve_cluster)
+out = run_serve_cluster(
+    default_requests(12, gen_len=48), start_np=2, warmup=2,
+    grow_when_done=5, extra_env={"KF_SERVE_MAX_BATCH": "4"},
+    port_range="26000-26999", timeout=360, markers=RESIZE_MARKERS)
+st = out["stats"]
+assert st["failed"] == 0 and st["done"] == 14, st
+print(f"SERVE SMOKE OK: {st['done']} requests, "
+      f"p99 {st['p99_ms']:.0f} ms through the grow")
+EOF
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

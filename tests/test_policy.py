@@ -21,7 +21,7 @@ import pytest
 
 from kungfu_tpu.elastic.policy import (GoodputPolicy,
                                        NaiveStragglerPolicy,
-                                       NoiseScalePolicy)
+                                       NoiseScalePolicy, SLOPolicy)
 from kungfu_tpu.trace.goodput import GoodputMeter
 from kungfu_tpu.trace.metrics import Registry
 
@@ -77,6 +77,73 @@ def test_target_equal_current_is_silent():
     p = NoiseScalePolicy(device_batch=64, hysteresis=1)
     p.observe(64 * 2)
     assert p(2) is None
+
+
+# -- the serving SLO policy (docs/serving.md) ---------------------------------
+
+def test_slo_policy_silent_without_observation():
+    assert SLOPolicy()(2) is None
+
+
+def test_slo_policy_grows_on_backlog_with_hysteresis():
+    p = SLOPolicy(backlog_per_worker=4, hysteresis=2)
+    p.observe(queue_depth=20, running=8, p99_ms=0.0)
+    assert p(2) is None                      # first sighting: hold
+    p.observe(queue_depth=20, running=8, p99_ms=0.0)
+    assert p(2) == 3                         # sustained: grow
+
+
+def test_slo_policy_grows_on_p99_violation():
+    p = SLOPolicy(p99_target_ms=100.0, hysteresis=1)
+    p.observe(queue_depth=0, running=1, p99_ms=250.0)
+    assert p(2) == 3
+
+
+def test_slo_policy_p99_signal_off_by_default():
+    p = SLOPolicy(hysteresis=1)              # p99_target_ms=0
+    p.observe(queue_depth=0, running=1, p99_ms=10_000.0)
+    assert p(2) is None
+
+
+def test_slo_policy_shrinks_after_sustained_idle():
+    p = SLOPolicy(hysteresis=1, idle_patience=3,
+                  capacity_per_worker=8)
+    for _ in range(2):
+        p.observe(queue_depth=0, running=2, p99_ms=1.0)
+        assert p(2) is None                  # not idle long enough
+    p.observe(queue_depth=0, running=2, p99_ms=1.0)
+    assert p(2) == 1                         # fits on one worker
+    # one shrink per idle episode: the counter re-arms
+    p.observe(queue_depth=0, running=2, p99_ms=1.0)
+    assert p(1) is None
+
+
+def test_slo_policy_never_shrinks_work_that_does_not_fit():
+    p = SLOPolicy(hysteresis=1, idle_patience=1,
+                  capacity_per_worker=4)
+    for _ in range(5):
+        p.observe(queue_depth=0, running=7, p99_ms=1.0)
+        # 7 in-flight > 1 worker x 4 slots: shrinking would thrash
+        assert p(2) is None
+
+
+def test_slo_policy_respects_bounds():
+    p = SLOPolicy(hysteresis=1, max_size=2, min_size=2,
+                  idle_patience=1)
+    p.observe(queue_depth=100, running=0, p99_ms=0.0)
+    assert p(2) is None                      # already at max
+    p.observe(queue_depth=0, running=0, p99_ms=0.0)
+    assert p(2) is None                      # already at min
+
+
+def test_slo_policy_flapping_signal_never_fires():
+    p = SLOPolicy(backlog_per_worker=4, hysteresis=2,
+                  idle_patience=99)
+    for _ in range(4):
+        p.observe(queue_depth=20, running=0, p99_ms=0.0)
+        assert p(2) is None                  # streak 1 of 2
+        p.observe(queue_depth=0, running=0, p99_ms=0.0)
+        assert p(2) is None                  # clean scrape resets
 
 
 # -- cost-aware policies ------------------------------------------------------

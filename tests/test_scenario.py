@@ -143,6 +143,37 @@ def test_host_preempt_validation_is_loud():
             {"kind": "resize", "step": 2, "size": 5}]})
 
 
+def test_serve_workload_loads_and_lowers():
+    """spot_serve_kill (docs/serving.md): workload rides spec ->
+    plan, the rank preempt lowers to the same crash_worker +
+    KF_RECOVER artifacts a train scenario gets, and the phase stays
+    single (the request ledger lives in the replay process)."""
+    s = load_scenario("spot_serve_kill")
+    assert s.workload == "serve"
+    plan = compile_scenario(s)
+    assert plan.workload == "serve" and len(plan.phases) == 1
+    assert plan.needs_recover
+    faults = plan.phases[0].chaos["faults"]
+    assert {"type": "crash_worker", "rank": s.np0 - 1, "step": 8,
+            "signal": "KILL"} in faults
+    # train scenarios keep the default workload untouched
+    assert compile_scenario(canned("diurnal")).workload == "train"
+
+
+def test_serve_workload_validation_is_loud():
+    base = {"name": "s", "np0": 2, "steps": 9, "workload": "serve"}
+    with pytest.raises(ValueError, match="unknown workload"):
+        load_scenario({**base, "workload": "batch"})
+    # serve has no ledger-relaunch story for whole-allocation kills:
+    # refuse at load, not after booting a tier that cannot comply
+    with pytest.raises(ValueError, match="rank-scoped"):
+        load_scenario({**base, "events": [
+            {"kind": "preempt", "step": 3, "scope": "cluster"}]})
+    with pytest.raises(ValueError, match="rank-scoped"):
+        load_scenario({**base, "np0": 4, "hosts": [2, 2], "events": [
+            {"kind": "preempt", "step": 3, "host": 1}]})
+
+
 def test_cluster_preempt_lowers_to_phases_with_cold_boot():
     plan = compile_scenario(canned("spot_preempt", np0=2))
     assert len(plan.phases) == 2 and plan.needs_ckpt

@@ -1,0 +1,108 @@
+"""kfserve end to end: the elastic decode tier under churn.
+
+Heavy multi-process cases (config server + kfrun + serve.worker
+replicas over the real control plane) behind the slow/chaos markers —
+the fast unit/parity coverage lives in tests/test_serve.py. Each case
+gates on the harness's request-plane contract: every submitted
+request completes and `RequestLedger.check_invariants()` is empty.
+"""
+
+import json
+
+import pytest
+
+from kungfu_tpu.serve.harness import (RECOVERY_MARKERS, RESIZE_MARKERS,
+                                      SERVE_MARKERS, default_requests,
+                                      run_serve_cluster,
+                                      seed_checkpoint)
+
+pytestmark = pytest.mark.slow
+
+
+def test_two_worker_tier_with_mid_traffic_grow(tmp_path):
+    """The run-all.sh stage-4h shape: 2 replicas serve a live mix, the
+    tier grows 2->3 through the consensus-resize path while traffic is
+    in flight (joiner adopts weights via the boot broadcast), and
+    every request completes with the ledger invariants clean."""
+    out = run_serve_cluster(
+        default_requests(12, gen_len=48), start_np=2, warmup=2,
+        grow_when_done=5, extra_env={"KF_SERVE_MAX_BATCH": "4"},
+        logdir=str(tmp_path), port_range="27400-27499",
+        timeout=360, markers=RESIZE_MARKERS)
+    st = out["stats"]
+    assert st["failed"] == 0 and st["done"] == 14
+    # survivors' in-flight requests decoded THROUGH the epoch switch:
+    # nothing was re-leased by the planned grow
+    assert all(r["leases"] == 1 for r in out["results"])
+
+
+@pytest.mark.chaos
+def test_decode_worker_killed_mid_request_completes_after_recovery(
+        tmp_path):
+    """The tentpole failure story: a chaos schedule SIGKILLs one
+    decode worker mid-request; its leases expire on the ledger, the
+    survivor adopts the shrunken stage, the schedule re-grows the
+    tier, and the resumed leases finish every request — completion
+    after recovery, token streams intact (the ledger's overlap check
+    would record any divergence as a violation)."""
+    chaos = json.dumps({"faults": [{"type": "crash_worker", "rank": 1,
+                                    "step": 8, "signal": "KILL"}]})
+    out = run_serve_cluster(
+        default_requests(10, gen_len=48),
+        schedule="999:2", start_np=2, recover=True,
+        extra_env={"KF_CHAOS": chaos, "KF_SERVE_MAX_BATCH": "4",
+                   "KF_SERVE_LEASE_MS": "3000"},
+        logdir=str(tmp_path), port_range="27400-27499",
+        timeout=360, markers=RECOVERY_MARKERS[:3] + (
+            ("KF_SERVE_JOINER", "the tier never re-grew"),))
+    logs = out["logs"]
+    assert ("KF_SERVE_RECOVERED" in logs
+            or "KF_SERVE_RESIZED rank=0 size=1" in logs), logs[-2500:]
+    # the victim's in-flight requests were resumed elsewhere
+    assert any(r["leases"] > 1 for r in out["results"])
+
+
+@pytest.mark.chaos
+def test_spot_serve_kill_scenario_replays(tmp_path):
+    """The canned scenario (docs/serving.md): spec -> compiler ->
+    serve-harness replay, same artifacts as every train scenario."""
+    from kungfu_tpu.scenario import canned, run_scenario
+
+    run = run_scenario(canned("spot_serve_kill"),
+                       trace_dir=str(tmp_path / "trace"),
+                       logdir=str(tmp_path / "logs"),
+                       port_range="27400-27499", timeout=360)
+    assert "KF_CHAOS_FIRE" in run.logs
+    assert "KF_SERVE_DONE" in run.logs
+
+
+def test_replicas_cold_boot_from_sharded_checkpoint_tier(tmp_path):
+    """KF_CKPT_DIR set: every version-0 replica restores the serve
+    model's params from the durable sharded tier RE-SHARDED to this
+    np (the generation was saved at np=1, the tier boots at np=2) —
+    serving weights come from training's durable rung, not a side
+    channel."""
+    ckpt = str(tmp_path / "ckpt")
+    seed_checkpoint(ckpt, size="tiny", max_len=64)
+    out = run_serve_cluster(
+        default_requests(6, gen_len=12), start_np=2,
+        extra_env={"KF_CKPT_DIR": ckpt},
+        logdir=str(tmp_path / "logs"), port_range="27400-27499",
+        timeout=360, markers=SERVE_MARKERS + (
+            ("KF_SERVE_RESTORED", "no replica restored from the "
+                                  "checkpoint tier"),))
+    assert out["stats"]["done"] == 6
+
+
+def test_slo_policy_grows_tier_under_backlog(tmp_path):
+    """KF_POLICY=slo: no schedule — the queue-depth/latency policy
+    reads /serve/stats and proposes the grow itself through the
+    ordinary propose -> consensus path."""
+    out = run_serve_cluster(
+        default_requests(24, gen_len=48), schedule="",
+        start_np=2, policy="slo",
+        extra_env={"KF_SERVE_MAX_BATCH": "2"},
+        logdir=str(tmp_path), port_range="27400-27499",
+        timeout=360, markers=SERVE_MARKERS + (
+            ("KF_SERVE_JOINER", "SLOPolicy never grew the tier"),))
+    assert out["stats"]["failed"] == 0
