@@ -58,6 +58,49 @@ FUSED_CE_GRID = [
 ]
 
 
+#: Paged-decode grid: the serving shapes `ops/paged_attn.paged_plan`
+#: must cover — (max_len, block_tokens, num_heads, head_dim, dtype).
+#: GPT-2 small/medium serving tiers at growing context plus the
+#: big-pool long-context point that pushes the resident scheme past
+#: any plausible budget (the plan must DEGRADE there, not OOM).
+PAGED_GRID = [
+    (1024, 16, 12, 64, "bfloat16"),
+    (2048, 16, 16, 64, "bfloat16"),
+    (4096, 32, 16, 64, "bfloat16"),
+    (4096, 16, 32, 128, "bfloat16"),
+    (8192, 32, 16, 64, "bfloat16"),
+    (2048, 16, 12, 64, "float32"),
+]
+
+
+def check_paged(grid: Sequence = PAGED_GRID,
+                budget: Optional[int] = None) -> List[Finding]:
+    import jax.numpy as jnp
+
+    from ..ops import paged_attn
+
+    budget = paged_attn._VMEM_BUDGET if budget is None else budget
+    findings = []
+    for max_len, bt, heads, d, dtype_name in grid:
+        dtype = jnp.dtype(dtype_name)
+        max_blocks = -(-max_len // bt)
+        plan = paged_attn.paged_plan(max_blocks, bt, heads, d,
+                                     dtype=dtype)
+        if plan["scheme"] == "functional":
+            continue  # stock-JAX fallback: nothing to compile
+        est = plan["vmem_bytes"]
+        if est > budget:
+            findings.append(Finding(
+                "kungfu_tpu/ops/paged_attn.py", 1, NAME,
+                f"paged decode plan at max_len={max_len} "
+                f"block_tokens={bt} heads={heads} d={d} "
+                f"dtype={dtype_name} picks scheme={plan['scheme']} "
+                f"with VMEM estimate {est / 2**20:.1f} MB > budget "
+                f"{budget / 2**20:.1f} MB — Mosaic would OOM at "
+                "compile time"))
+    return findings
+
+
 def check_flash(grid: Sequence = FLASH_GRID,
                 budget: Optional[int] = None) -> List[Finding]:
     import jax.numpy as jnp
@@ -125,8 +168,8 @@ def check_fused_ce(grid: Sequence = FUSED_CE_GRID,
 
 class VmemBudgetPass:
     name = NAME
-    doc = ("flash/fused_ce block plans evaluated over the benchmark "
-           "shape grid must fit the VMEM budget")
+    doc = ("flash/fused_ce/paged-decode block plans evaluated over "
+           "the benchmark shape grid must fit the VMEM budget")
 
     def run_global(self, paths: Sequence[str]) -> List[Finding]:
         # only meaningful when the analyzed tree contains the kernels
@@ -136,8 +179,9 @@ class VmemBudgetPass:
             os.path.isdir(p) and any(
                 os.path.exists(os.path.join(root, "flash.py"))
                 for root, _, _ in os.walk(p))
-            or os.path.basename(p) in ("flash.py", "fused_ce.py")
+            or os.path.basename(p) in ("flash.py", "fused_ce.py",
+                                       "paged_attn.py")
             for p in paths)
         if not covers:
             return []
-        return check_flash() + check_fused_ce()
+        return check_flash() + check_fused_ce() + check_paged()

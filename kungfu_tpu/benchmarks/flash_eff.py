@@ -18,6 +18,17 @@ kernel configuration produced it.
 `benchmarks/lm.py --attention flash` embeds the same measurement in
 its meta (key `flash_kernel`), so the flagship flash row and its
 kernel efficiency publish together.
+
+`--paged` measures the serving-side paged-attention DECODE kernel
+instead (`ops/paged_attn.py`). Decode attention is memory-bound, so
+its roofline axis is bytes/s, not FLOP/s: the traffic model is the
+block-pool bytes the table-chasing kernel actually VISITS
+(`paged_traffic_bytes` — the visible blocks of each ragged row, K and
+V), and the report divides that by the measured per-call time. The
+point of the paged kernel is exactly that visited bytes, not
+B * max_blocks * block_tokens, is what moves.
+
+  python -m kungfu_tpu.benchmarks.flash_eff --paged --max-len 2048
 """
 
 from __future__ import annotations
@@ -116,6 +127,95 @@ def measure_flash_efficiency(batch: int = 8, seq: int = 1024,
     return meta
 
 
+def measure_paged_bandwidth(batch: int = 8, max_len: int = 2048,
+                            block_tokens: int = 16, heads: int = 12,
+                            head_dim: int = 64,
+                            dtype: str = "bfloat16", iters: int = 20,
+                            warmup: int = 3):
+    """Achieved bandwidth of the paged-attention decode kernel at one
+    serving shape.
+
+    Traffic = `paged_traffic_bytes` over the (ragged) batch lengths:
+    the visible K/V pool blocks each row's table chase actually DMAs.
+    Reports per-call ms, visited bytes, achieved GB/s, and the
+    visited fraction of the whole pool (the saving over a dense
+    gather) plus the `paged_plan` that ran."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from kungfu_tpu.ops.paged_attn import (paged_attention, paged_plan,
+                                           paged_traffic_bytes)
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":  # interpret-mode smoke: keep the pool tiny
+        batch, max_len, heads = min(batch, 2), min(max_len, 64), \
+            min(heads, 4)
+        block_tokens = min(block_tokens, 8)
+        iters, warmup = min(iters, 2), min(warmup, 1)
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    bt = block_tokens
+    max_blocks = -(-max_len // bt)
+    plan = paged_plan(max_blocks, bt, heads, head_dim, dtype=dt)
+    meta = {
+        "platform": platform, "batch": batch, "max_len": max_len,
+        "block_tokens": bt, "heads": heads, "head_dim": head_dim,
+        "dtype": dtype, "iters": iters, "plan": plan,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    if plan["scheme"] == "functional":
+        meta["skipped"] = ("paged_plan chose the functional fallback "
+                           "at this shape — nothing to time")
+        return meta
+    num_pool = 1 + batch * max_blocks      # + the scratch block 0
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch, heads, head_dim), dt)
+    k_pool = jax.random.normal(kk, (num_pool, bt, heads, head_dim), dt)
+    v_pool = jax.random.normal(kv, (num_pool, bt, heads, head_dim), dt)
+    # ragged lengths (the traffic model's point); disjoint tables
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(max_len // 2, max_len - 1,
+                           size=batch).astype(np.int32)
+    tables = (1 + np.arange(batch * max_blocks, dtype=np.int32)
+              .reshape(batch, max_blocks))
+    fn = jax.jit(lambda q, kp, vp, tb, ln: paged_attention(
+        q, kp, vp, tb, ln, scheme=plan["scheme"]))
+    args = (q, k_pool, v_pool, jnp.asarray(tables),
+            jnp.asarray(lengths))
+
+    # the same slope-timing discipline as the flash measurement: the
+    # end-of-loop fence is a constant that cancels in the difference
+    k_lo, k_hi = max(iters, 1), 3 * max(iters, 1)
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    for _ in range(max(warmup, 1)):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    run(k_lo)
+    t_lo = min(run(k_lo) for _ in range(2))
+    t_hi = min(run(k_hi) for _ in range(2))
+    t = max((t_hi - t_lo) / (k_hi - k_lo), 1e-9)
+
+    isz = jnp.dtype(dt).itemsize
+    visited = paged_traffic_bytes(lengths, bt, heads, head_dim, isz)
+    pool_bytes = 2 * (num_pool - 1) * bt * heads * head_dim * isz
+    meta.update({
+        "lengths": [int(n) for n in lengths],
+        "decode_ms": round(t * 1000, 3),
+        "visited_bytes": int(visited),
+        "visited_fraction_of_pool": round(visited / pool_bytes, 4),
+        "achieved_gbps": round(visited / t / 1e9, 3),
+    })
+    return meta
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=8)
@@ -127,7 +227,25 @@ def main(argv=None) -> int:
     ap.add_argument("--dtype", default="bfloat16",
                     choices=("bfloat16", "float32"))
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--paged", action="store_true",
+                    help="measure the paged-attention decode kernel's "
+                         "achieved bandwidth instead")
+    ap.add_argument("--max-len", type=int, default=2048,
+                    help="--paged: per-sequence pool reservation")
+    ap.add_argument("--block-tokens", type=int, default=16,
+                    help="--paged: KV block size in tokens")
     args = ap.parse_args(argv)
+    if args.paged:
+        meta = measure_paged_bandwidth(
+            args.batch, args.max_len, args.block_tokens, args.heads,
+            args.head_dim, dtype=args.dtype, iters=args.iters)
+        print(json.dumps({
+            "metric": "paged_decode_achieved_gbps",
+            "value": meta.get("achieved_gbps"),
+            "unit": "GB/s of visited block-pool bytes",
+            "details": meta,
+        }))
+        return 0
     meta = measure_flash_efficiency(
         args.batch, args.seq, args.heads, args.head_dim,
         causal=not args.no_causal, window=args.window,

@@ -104,6 +104,14 @@ CONFIG_VARS = (
     "KF_SERVE_BLOCKS",
     "KF_SERVE_EXPECT",
     "KF_SERVE_MAX_ITERS",
+    # serving fast path (docs/serving.md "The fast path"): decode
+    # kernel selection (auto = plan's pick on TPU / functional on
+    # CPU; kernel = force the plan's pick, interpret mode off-TPU),
+    # chunked-prefill chunk size in tokens (0 = whole-prompt
+    # prefill), and copy-on-write prefix sharing across requests
+    "KF_SERVE_KERNEL",
+    "KF_SERVE_PREFILL_CHUNK",
+    "KF_SERVE_SHARE_PREFIX",
 )
 
 ALL_BOOTSTRAP_VARS = (
@@ -249,6 +257,10 @@ def from_env(environ: Optional[Dict[str, str]] = None) -> Config:
     env_int("KF_SERVE_BLOCKS", 0, e, minimum=0)
     env_int("KF_SERVE_EXPECT", 0, e, minimum=0)
     env_int("KF_SERVE_MAX_ITERS", 20_000, e, minimum=1)
+    env_choice("KF_SERVE_KERNEL", "auto",
+               ("auto", "kernel", "functional"), e)
+    env_int("KF_SERVE_PREFILL_CHUNK", 0, e, minimum=0)
+    env_flag("KF_SERVE_SHARE_PREFIX", True, e)
     self_spec = e.get(SELF_SPEC, "")
     if not self_spec:
         solo = PeerID.from_host("127.0.0.1", 0)

@@ -48,6 +48,9 @@ __all__ = [
     "flash_attention",
     "flash_plan",
     "flash_attention_flops",
+    "paged_attention",
+    "paged_plan",
+    "paged_traffic_bytes",
     "all_reduce",
     "all_reduce_mean",
     "group_all_reduce",
@@ -87,5 +90,11 @@ def __getattr__(name):
 
         attr = getattr(flash, name)
         globals()[name] = attr  # cache: next lookup is direct
+        return attr
+    if name in ("paged_attention", "paged_plan", "paged_traffic_bytes"):
+        from . import paged_attn
+
+        attr = getattr(paged_attn, name)
+        globals()[name] = attr
         return attr
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

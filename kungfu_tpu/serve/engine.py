@@ -109,6 +109,19 @@ class _Seq:
     cache_len: int                    # tokens currently in pool blocks
     last_token: int                   # next decode input
     generated: List[int] = field(default_factory=list)
+    # chunked-prefill state: `prompt` holds the full token list while
+    # the sequence is still prefilling (None once decode-ready);
+    # `prefill_pos` is the next position to prefill (starts past any
+    # prefix-shared tokens)
+    prompt: Optional[List[int]] = None
+    prefill_pos: int = 0
+    order: int = 0                    # admission order (FIFO prefill)
+    # deferred prefills hold NO pool blocks until their first chunk
+    # runs (`_prefill_step` admits lazily) — by then every
+    # earlier-ordered prefill has committed, so a burst of identical
+    # prompts admitted in one iteration still shares the first
+    # arrival's blocks instead of each prefilling privately
+    pending: bool = False
 
 
 class DecodeEngine:
@@ -116,7 +129,9 @@ class DecodeEngine:
 
     def __init__(self, model, params, max_batch: int,
                  block_tokens: int, max_len: int,
-                 num_blocks: int = 0, eos: Optional[int] = None):
+                 num_blocks: int = 0, eos: Optional[int] = None,
+                 kernel: str = "functional", prefill_chunk: int = 0,
+                 share_prefix: bool = False):
         from . import paged
 
         cfg = model.config
@@ -139,10 +154,98 @@ class DecodeEngine:
         self.pool = PagedKVPool(num_blocks, block_tokens)
         self.pool_k, self.pool_v = paged.init_pool_tensors(
             cfg, num_blocks, block_tokens)
-        self._decode = paged.make_decode_fn(cfg)
+        # KF_SERVE_KERNEL resolution happens ONCE, here: "auto" means
+        # the plan's pick on TPU and the functional path on CPU;
+        # "kernel" forces the plan's pick (interpret mode off-TPU);
+        # an over-budget plan degrades to functional either way
+        self.kernel = self._resolve_kernel(kernel, block_tokens)
+        self._decode = paged.make_decode_fn(cfg, kernel=self.kernel)
+        self._prefill = paged.make_prefill_chunk_fn(cfg)
+        self.prefill_chunk = int(prefill_chunk)
+        self.share_prefix = bool(share_prefix)
         self._slots: List[Optional[object]] = [None] * self.max_batch
         self._seqs: Dict[object, _Seq] = {}
+        self._admitted = 0
         self.steps = 0
+        # wall-clock accounting for the per-np breakdown benchmark
+        self.decode_s = 0.0
+        self.prefill_s = 0.0
+        self.prefill_chunks = 0
+
+    def _resolve_kernel(self, knob: str, block_tokens: int) -> str:
+        """Map the KF_SERVE_KERNEL knob to the decode_step kernel
+        argument, consulting `paged_plan` so an over-budget shape
+        falls back to the functional path at construction (not at
+        Mosaic compile time)."""
+        if knob == "functional":
+            return "functional"
+        import jax
+
+        if knob == "auto" and jax.default_backend() != "tpu":
+            return "functional"
+        if knob in ("auto", "kernel"):
+            from ..ops import paged_attn
+
+            plan = paged_attn.paged_plan(
+                self.max_blocks, block_tokens, self.cfg.num_heads,
+                self.cfg.hidden_size // self.cfg.num_heads,
+                dtype=self.cfg.dtype)
+            return plan["scheme"]
+        return knob  # explicit "resident"/"stream" (tests)
+
+    def warm(self) -> None:
+        """Compile every signature the serving loop can hit, BEFORE
+        the first request: the decode step at its one fixed
+        (max_batch, max_blocks) shape, the chunk-prefill buckets, and
+        the whole-prefill length buckets. A replica that jits on its
+        first real request stalls it for seconds — and on a shared
+        host every OTHER replica's requests contend with that compile
+        (the inverse-np scaling BENCH_r15 published was mostly
+        laggard replicas compiling inside the measured window). All
+        warm traffic lands in the scratch block (length/true_len 0 —
+        masked out of every real row forever); wall time is NOT added
+        to the prefill/decode accounting."""
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from . import paged
+
+        bt = self.pool.block_tokens
+        tables = self.pool.batch_tables([], self.max_blocks,
+                                        pad_rows=self.max_batch)
+        zeros = np.zeros(self.max_batch, np.int32)
+        logits, self.pool_k, self.pool_v = self._decode(
+            self.params, self.pool_k, self.pool_v, tables, zeros,
+            zeros)
+        jax.block_until_ready(logits)
+        # chunk buckets: the configured chunk size plus the one-block
+        # bucket (remainders and the recomputed tail of a fully
+        # shared prompt both land there)
+        chunk_buckets = {bt}
+        if self.prefill_chunk:
+            chunk_buckets.add(-(-self.prefill_chunk // bt) * bt)
+        row = np.zeros(self.max_blocks, np.int32)
+        for c in sorted(chunk_buckets):
+            logits, self.pool_k, self.pool_v = self._prefill(
+                self.params, self.pool_k, self.pool_v,
+                jnp.asarray(row), 0,
+                jnp.asarray(np.zeros(c, np.int32)), 0)
+            jax.block_until_ready(logits)
+        # whole-prefill buckets: with chunking on, prompts longer
+        # than the chunk defer to the incremental path, so only the
+        # buckets up to the chunk size can reach paged.prefill
+        whole = (min(-(-self.prefill_chunk // bt), self.max_blocks)
+                 if self.prefill_chunk else self.max_blocks)
+        for nb in range(1, whole + 1):
+            arr = jnp.zeros((1, nb * bt), jnp.int32)
+            logits, ks, vs = paged.prefill(self.model, self.params,
+                                           arr)
+            self.pool_k, self.pool_v = paged.write_prefill(
+                self.pool_k, self.pool_v, [0] * nb, ks[:, 0],
+                vs[:, 0], bt)
+            jax.block_until_ready(logits)
 
     # -- admission ----------------------------------------------------------
 
@@ -159,10 +262,18 @@ class DecodeEngine:
                 and self.pool.can_admit(prompt_len))
 
     def admit(self, seq_id, prompt: List[int],
-              max_new: int) -> Tuple[int, bool]:
-        """Prefill `prompt` into a free slot; returns ``(first_token,
-        done)``. Raises KVPoolExhausted / ValueError when it cannot —
-        the caller's admission queue keeps the request."""
+              max_new: int) -> Tuple[Optional[int], bool]:
+        """Admit `prompt` into a free slot. When neither prefix
+        sharing nor chunking applies, the whole prompt prefills here
+        and ``(first_token, done)`` returns as before. Otherwise the
+        prefill is DEFERRED: the sequence enters the prefilling state,
+        ``(None, False)`` returns immediately, and `step()` advances
+        the prefill one chunk per iteration (interleaved with decode)
+        until the first token is emitted through its `emitted` map.
+        Raises KVPoolExhausted / ValueError when it cannot admit — the
+        caller's admission queue keeps the request."""
+        import time
+
         import numpy as np
 
         import jax.numpy as jnp
@@ -179,14 +290,58 @@ class DecodeEngine:
                 f"prompt length {t} outside (0, {self.max_len})")
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
-        table = self.pool.admit(seq_id, t)
         bt = self.pool.block_tokens
+        # how much of the prompt COULD be skipped: committed donors in
+        # the prefix index now, plus full-block prefixes of sequences
+        # still prefilling — those run before this one (FIFO order)
+        # and commit on completion, so deferring lets this sequence
+        # share blocks that do not exist yet
+        committed = inflight = 0
+        if self.share_prefix:
+            committed = self.pool.match_prefix(prompt)[1]
+            for q in self._seqs.values():
+                if q.prompt is None:
+                    continue
+                lim = min(q.prompt_len, t)
+                m = 0
+                while ((m + 1) * bt <= lim
+                       and q.prompt[m * bt:(m + 1) * bt]
+                       == prompt[m * bt:(m + 1) * bt]):
+                    m += 1
+                inflight = max(inflight, m * bt)
+        potential = min(max(committed, inflight), t - 1)
+        slot = self._slots.index(None)
+        self._admitted += 1
+        if potential > 0 or (self.prefill_chunk
+                             and t - potential > self.prefill_chunk):
+            # incremental path: step() owns the prefill from here
+            seq = _Seq(slot=slot, prompt_len=t, max_new=int(max_new),
+                       cache_len=t, last_token=int(prompt[-1]),
+                       prompt=list(prompt), prefill_pos=0,
+                       order=self._admitted, pending=True)
+            if committed > 0 and committed >= inflight:
+                # donors are ALREADY committed: map them now, so the
+                # blocks-in-use collapse is visible at admit time and
+                # pool pressure accounts the sharer immediately (a
+                # failure here propagates with nothing registered)
+                self.pool.admit(seq_id, t, prompt=prompt)
+                seq.pending = False
+                seq.prefill_pos = min(self.pool.shared_tokens(seq_id),
+                                      t - 1)
+            # otherwise the pool admission is LAZY (`pending`) so the
+            # prefix match runs after the in-flight donors commit
+            self._slots[slot] = seq_id
+            self._seqs[seq_id] = seq
+            return None, False
+        table = self.pool.admit(
+            seq_id, t, prompt=prompt if self.share_prefix else None)
         # pad the prompt to a block-sized bucket: one prefill compile
         # per bucket instead of per distinct length (causal masking
         # keeps every real position independent of the padding)
         padded = -(-t // bt) * bt
         arr = np.zeros((1, padded), np.int32)
         arr[0, :t] = prompt
+        t0 = time.perf_counter()
         with trace.span("request.prefill", cat="serve", seq=str(seq_id),
                         prompt_len=t):
             logits, ks, vs = paged.prefill(self.model, self.params,
@@ -197,9 +352,12 @@ class DecodeEngine:
                 self.pool_k, self.pool_v, table,
                 ks[:, 0], vs[:, 0], bt)
             tok0 = int(jnp.argmax(logits[0, t - 1]))
-        slot = self._slots.index(None)
+        self.prefill_s += time.perf_counter() - t0
+        if self.share_prefix:
+            self.pool.commit_prefix(seq_id, prompt)
         seq = _Seq(slot=slot, prompt_len=t, max_new=int(max_new),
-                   cache_len=t, last_token=tok0, generated=[tok0])
+                   cache_len=t, last_token=tok0, generated=[tok0],
+                   order=self._admitted)
         done = self._finished(seq)
         if done:
             self.pool.release(seq_id)
@@ -218,17 +376,17 @@ class DecodeEngine:
 
     # -- the iteration ------------------------------------------------------
 
-    def _make_room(self, seq_id) -> List[object]:
-        """Extend `seq_id`'s table by one position, preempting the
-        youngest OTHER live sequence (fewest generated tokens) until
-        it fits; preempting `seq_id` itself is the last resort.
-        Returns the preempted ids."""
+    def _reserve(self, seq_id, attempt) -> Tuple[
+            List[object], List[Tuple[int, int]]]:
+        """Run `attempt` (an allocator call on behalf of `seq_id`),
+        preempting the youngest OTHER live sequence (fewest generated
+        tokens) on exhaustion until it succeeds; preempting `seq_id`
+        itself is the last resort. Returns ``(preempted ids,
+        (src, dst) pool-tensor copies the allocator requested)``."""
         preempted: List[object] = []
         while True:
             try:
-                self.pool.grow(
-                    seq_id, self._seqs[seq_id].cache_len + 1)
-                return preempted
+                return preempted, attempt()
             except KVPoolExhausted:
                 victims = sorted(
                     self._seqs,
@@ -238,36 +396,161 @@ class DecodeEngine:
                 self._drop(victim)
                 preempted.append(victim)
                 if victim == seq_id:
-                    return preempted
+                    return preempted, []
+
+    def _make_room(self, seq_id) -> Tuple[List[object],
+                                          List[Tuple[int, int]]]:
+        """Extend `seq_id`'s table by one position (copy-on-write of
+        a shared last block included)."""
+        return self._reserve(
+            seq_id,
+            lambda: self.pool.grow(
+                seq_id, self._seqs[seq_id].cache_len + 1))
 
     def _drop(self, seq_id) -> None:
         seq = self._seqs.pop(seq_id)
         self._slots[seq.slot] = None
-        self.pool.release(seq_id)
+        if not seq.pending:  # pending seqs hold no pool blocks yet
+            self.pool.release(seq_id)
+
+    def _prefill_step(self, seq_id, emitted: Dict[object,
+                                                  Tuple[int, bool]],
+                      preempted: List[object]) -> None:
+        """Advance `seq_id`'s deferred prefill by one chunk. On the
+        final chunk the first token is computed from the last real
+        position's logits and reported through `emitted` exactly like
+        a decode step's token."""
+        import time
+
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from . import paged
+
+        seq = self._seqs[seq_id]
+        t = seq.prompt_len
+        bt = self.pool.block_tokens
+        if seq.pending:
+            # lazy pool admission: every earlier-ordered prefill has
+            # completed (and, with sharing, committed), so the prefix
+            # match sees donors that did not exist at admit() time
+            pre, _ = self._reserve(
+                seq_id,
+                lambda: self.pool.admit(
+                    seq_id, t,
+                    prompt=seq.prompt if self.share_prefix else None))
+            preempted.extend(pre)
+            if seq_id not in self._seqs:  # could not fit even alone
+                return
+            seq.pending = False
+            # never share the FULL prompt: position t-1 must be
+            # recomputed so the first token's logits exist (the
+            # one-token chunk that recomputes it goes through
+            # copy-on-write, so a shared donor block is never
+            # overwritten)
+            seq.prefill_pos = min(self.pool.shared_tokens(seq_id),
+                                  t - 1)
+        start = seq.prefill_pos
+        real = t - start
+        if self.prefill_chunk:
+            real = min(real, self.prefill_chunk)
+        # writes into shared/committed blocks (the divergence point,
+        # or the recomputed last position of a fully-shared prompt)
+        # swap in private copies first
+        pre, copies = self._reserve(
+            seq_id,
+            lambda: self.pool.cow_for_write(seq_id, start, start + real))
+        preempted.extend(pre)
+        if seq_id not in self._seqs:  # lost its own blocks
+            return
+        if copies:
+            self.pool_k, self.pool_v = paged.copy_blocks(
+                self.pool_k, self.pool_v, copies)
+        # chunks pad to a block multiple: one compile per chunk bucket
+        # (pad positions scatter to the scratch block, masked off)
+        c = -(-real // bt) * bt
+        toks = np.zeros(c, np.int32)
+        toks[:real] = seq.prompt[start:start + real]
+        table = np.full(self.max_blocks, 0, np.int32)
+        row = self.pool.table(seq_id)
+        table[:len(row)] = row
+        t0 = time.perf_counter()
+        with trace.span("request.prefill_chunk", cat="serve",
+                        seq=str(seq_id), start=start, tokens=real):
+            logits, self.pool_k, self.pool_v = self._prefill(
+                self.params, self.pool_k, self.pool_v,
+                jnp.asarray(table), start, jnp.asarray(toks), t)
+            logits = jax.block_until_ready(logits)
+        self.prefill_s += time.perf_counter() - t0
+        self.prefill_chunks += 1
+        seq.prefill_pos = start + real
+        if seq.prefill_pos < t:
+            return
+        tok0 = int(np.asarray(logits)[real - 1].argmax())
+        if self.share_prefix:
+            self.pool.commit_prefix(seq_id, seq.prompt)
+        seq.prompt = None
+        seq.generated = [tok0]
+        seq.last_token = tok0
+        seq.cache_len = t
+        done = self._finished(seq)
+        if done:
+            self._drop(seq_id)
+        emitted[seq_id] = (tok0, done)
 
     def step(self) -> Tuple[Dict[object, Tuple[int, bool]],
                             List[object]]:
-        """One decode iteration over every live slot.
+        """One iteration over every live slot: at most ONE prefilling
+        sequence advances by one chunk (admission order), then every
+        decode-ready slot decodes — prefill is interleaved with
+        decode instead of stalling it.
 
         Returns ``(emitted, preempted)``: `emitted` maps seq_id ->
-        (token, done) for every sequence that decoded this iteration;
-        `preempted` lists sequences evicted by pool pressure (their
-        blocks are freed; re-admit to resume). No live slots -> both
-        empty.
+        (token, done) for every sequence that emitted a token this
+        iteration (a decode step's token, or a completed prefill's
+        first token); `preempted` lists sequences evicted by pool
+        pressure (their blocks are freed; re-admit to resume). No
+        live slots -> both empty.
         """
+        import time
+
         import numpy as np
+
+        from . import paged
 
         if not self._seqs:
             return {}, []
-        # capacity first: every row's incoming token needs a slot in
-        # its block table BEFORE the batched scatter runs
+        emitted: Dict[object, Tuple[int, bool]] = {}
         preempted: List[object] = []
+        prefilling = sorted(
+            (s for s, q in self._seqs.items() if q.prompt is not None),
+            key=lambda s: self._seqs[s].order)
+        if prefilling:
+            self._prefill_step(prefilling[0], emitted, preempted)
+        # capacity first: every decoding row's incoming token needs a
+        # slot in its block table BEFORE the batched scatter runs —
+        # and any copy-on-write the growth requests must land BEFORE
+        # the scatter too (one batched copy, gathers read pre-copy
+        # state so overlapping src/dst rows stay consistent)
+        copies: List[Tuple[int, int]] = []
         for seq_id in [s for s in self._slots if s is not None]:
-            if seq_id in self._seqs:  # not preempted by an earlier row
-                preempted.extend(self._make_room(seq_id))
-        live = [s for s in self._slots if s is not None]
+            if (seq_id in self._seqs and seq_id not in emitted
+                    and self._seqs[seq_id].prompt is None):
+                pre, cps = self._make_room(seq_id)
+                preempted.extend(pre)
+                copies.extend(cps)
+        if copies:
+            self.pool_k, self.pool_v = paged.copy_blocks(
+                self.pool_k, self.pool_v, copies)
+        live = [s for s in self._slots
+                if s is not None and s in self._seqs
+                and s not in emitted
+                and self._seqs[s].prompt is None]
+        self.steps += 1
         if not live:
-            return {}, preempted
+            return emitted, preempted
         order = {s: self._seqs[s].slot for s in live}
         tokens = np.zeros(self.max_batch, np.int32)
         lengths = np.zeros(self.max_batch, np.int32)
@@ -279,13 +562,14 @@ class DecodeEngine:
             lengths[slot] = seq.cache_len
             row = self.pool.table(s)
             tables[slot, :len(row)] = row
+        t0 = time.perf_counter()
         with trace.span("serve.decode_step", cat="serve",
                         batch=len(live)):
             logits, self.pool_k, self.pool_v = self._decode(
                 self.params, self.pool_k, self.pool_v, tables,
                 lengths, tokens)
             toks = np.asarray(logits.argmax(axis=-1))
-        emitted: Dict[object, Tuple[int, bool]] = {}
+        self.decode_s += time.perf_counter() - t0
         for s, slot in order.items():
             seq = self._seqs[s]
             tok = int(toks[slot])
@@ -296,7 +580,6 @@ class DecodeEngine:
             if done:
                 self._drop(s)
             emitted[s] = (tok, done)
-        self.steps += 1
         return emitted, preempted
 
     def drain(self, seq_id) -> None:
@@ -308,6 +591,13 @@ class DecodeEngine:
 
     def live(self) -> List[object]:
         return [s for s in self._slots if s is not None]
+
+    def prefilling(self) -> List[object]:
+        """Live sequences still in the chunked-prefill state (they
+        emit nothing until their last chunk — the worker heartbeats
+        their leases)."""
+        return [s for s, q in self._seqs.items()
+                if q.prompt is not None]
 
     def is_live(self, seq_id) -> bool:
         return seq_id in self._seqs

@@ -22,6 +22,11 @@ Routes (all JSON):
   plane health gate (empty == healthy).
 - worker verbs: ``POST /serve/lease`` {"max": n, "worker": w},
   ``POST /serve/append`` {"id", "pos", "tokens", "done", "worker"},
+  ``POST /serve/append_batch`` {"rows": [{"id", "pos", "tokens",
+  "done"}, ...], "worker": w} -> {"statuses": [...], "stats": {...}}
+  — ONE round trip per decode iteration, ledger stats piggybacked so
+  the worker skips its separate /serve/stats poll (the per-sequence
+  append storm behind BENCH_r15's inverse np scaling),
   ``POST /serve/release`` {"id", "worker"}.
 
 Like ``/trace``, the ``/serve`` plane is EXEMPT from the chaos HTTP
@@ -47,8 +52,8 @@ from .ledger import AdmissionFull, RequestLedger
 
 __all__ = [
     "handle_serve", "serve_url", "submit", "result", "results",
-    "stats", "invariants", "lease", "append", "release",
-    "RequestLedger",
+    "stats", "invariants", "lease", "append", "append_batch",
+    "release", "RequestLedger",
 ]
 
 
@@ -80,6 +85,12 @@ def handle_serve(ledger: RequestLedger, method: str, path: str,
                 done=bool(doc.get("done", False)),
                 worker=str(doc.get("worker", "")))
             return 200, json.dumps({"status": status})
+        if method == "POST" and route == "/serve/append_batch":
+            statuses = ledger.append_batch(
+                list(doc.get("rows", [])),
+                worker=str(doc.get("worker", "")))
+            return 200, json.dumps({"statuses": statuses,
+                                    "stats": ledger.stats()})
         if method == "POST" and route == "/serve/release":
             ledger.release(int(doc["id"]),
                            worker=str(doc.get("worker", "")))
@@ -156,6 +167,19 @@ def append(url: str, rid: int, pos: int, tokens: List[int],
                                "worker": worker}),
                    retry=retry)
     return json.loads(out)["status"]
+
+
+def append_batch(url: str, rows: List[Dict], worker: str,
+                 retry=None) -> Tuple[List[str], Dict]:
+    """One POST per decode iteration: per-row append statuses plus
+    the piggybacked ledger stats (saves the separate /serve/stats
+    poll). Rows are overlap-idempotent on the ledger, so the shared
+    retry policy is safe here like everywhere else."""
+    out = post_url(serve_url(url, "/append_batch"),
+                   json.dumps({"rows": rows, "worker": worker}),
+                   retry=retry)
+    doc = json.loads(out)
+    return list(doc["statuses"]), dict(doc["stats"])
 
 
 def release(url: str, rid: int, worker: str, retry=None) -> None:

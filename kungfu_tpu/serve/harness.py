@@ -63,6 +63,24 @@ def default_requests(n: int, gen_len: int = 12,
     return out
 
 
+def prefix_requests(n: int, prefix_len: int = 48, gen_len: int = 12,
+                    vocab: int = 50257, seed: int = 23
+                    ) -> List[Tuple[List[int], int]]:
+    """Prefix-heavy request mix (system prompt + short user tails):
+    every request shares one `prefix_len`-token common prefix and
+    diverges only in a 2-4 token tail — the workload CoW prefix
+    sharing collapses (`KF_SERVE_SHARE_PREFIX`, docs/serving.md)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    common = [int(t) for t in rng.integers(0, vocab, size=prefix_len)]
+    out = []
+    for _ in range(n):
+        tail = rng.integers(0, vocab, size=2 + int(rng.integers(0, 3)))
+        out.append((common + [int(t) for t in tail], gen_len))
+    return out
+
+
 def run_serve_cluster(
         requests: Sequence[Tuple[List[int], int]],
         schedule: str = "",

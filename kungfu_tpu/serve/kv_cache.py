@@ -32,6 +32,23 @@ reused block's stale bytes are never visible. The
 `test_no_cross_request_leakage` fixture in tests/test_serve.py pins
 exactly that (reused-pool logits bitwise == fresh-pool logits).
 
+**Copy-on-write prefix sharing** (vLLM, PAPERS.md): blocks are
+refcounted, and a *prefix index* maps the token tuple of every
+committed full prompt block to its block id. `admit` walks the index
+over the new prompt's block-aligned prefixes and maps every hit into
+the new table instead of re-prefilling it (a final *partial* block is
+shared too when its first `r` tokens extend the prompt — positions
+past the sequence's length are masked, so the donor's extra tokens
+are invisible). Committed blocks are immutable: any write that would
+land in a shared or committed block — decode's append, or a chunked
+prefill resuming at the divergence point — first goes through
+`grow`/`cow_for_write`, which swap in a fresh private block and hand
+the caller the (src, dst) pool-tensor copies to execute. `release`
+decrements; a block leaves circulation (and the index) only at
+refcount zero. K/V at position p depends only on tokens[0..p], so
+token-prefix equality is exactly K/V-prefix equality and sharing is
+bitwise-lossless.
+
 `kf_kv_blocks_in_use` (gauge, docs/observability.md) tracks pool
 pressure — the admission-control signal `SLOPolicy` and operators
 watch.
@@ -39,7 +56,7 @@ watch.
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..trace import metrics
 
@@ -75,7 +92,42 @@ class PagedKVPool:
         self._free: List[int] = list(range(self.num_blocks, 0, -1))
         self._tables: Dict[object, List[int]] = {}
         self._lengths: Dict[object, int] = {}
+        #: block id -> number of owning sequences (blocks in circulation)
+        self._refs: Dict[int, int] = {}
+        #: full-prefix token tuple (block-aligned) -> committed block id
+        self._index: Dict[tuple, int] = {}
+        #: reverse of _index — committed block id -> its prefix key
+        self._block_key: Dict[int, tuple] = {}
+        #: seq -> tokens mapped from the index at admit time
+        self._shared: Dict[object, int] = {}
         self._publish()
+
+    # -- refcounting --------------------------------------------------------
+
+    def _alloc(self) -> int:
+        b = self._free.pop()
+        self._refs[b] = 1
+        return b
+
+    def _incref(self, b: int) -> None:
+        self._refs[b] += 1
+
+    def _decref(self, b: int) -> None:
+        n = self._refs[b] - 1
+        if n:
+            self._refs[b] = n
+            return
+        del self._refs[b]
+        key = self._block_key.pop(b, None)
+        if key is not None:
+            del self._index[key]  # evict-on-free: no dangling donors
+        self._free.append(b)
+
+    def _is_private(self, b: int) -> bool:
+        """Writable in place: sole owner AND not published as a prefix
+        donor (committed blocks stay immutable even at refcount 1 —
+        a later admission may map them at any moment)."""
+        return self._refs.get(b, 0) == 1 and b not in self._block_key
 
     # -- allocator ----------------------------------------------------------
 
@@ -98,43 +150,150 @@ class PagedKVPool:
     def can_admit(self, tokens: int) -> bool:
         return self.blocks_for(tokens) <= len(self._free)
 
-    def admit(self, seq, tokens: int) -> List[int]:
+    def match_prefix(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest committed prefix of `prompt`: returns (block ids to
+        map shared, tokens they cover). Walks the index over
+        block-aligned prefixes; when every full block matched and a
+        committed block's first `r` tokens extend the remainder, that
+        block is shared partially (the donor's tail past the new
+        sequence's length is masked, hence invisible). Read-only."""
+        prompt = list(prompt)
+        t = len(prompt)
+        bt = self.block_tokens
+        blocks: List[int] = []
+        while (len(blocks) + 1) * bt <= t:
+            b = self._index.get(tuple(prompt[: (len(blocks) + 1) * bt]))
+            if b is None:
+                break
+            blocks.append(b)
+        shared = len(blocks) * bt
+        r = t - shared
+        if 0 < r < bt and len(blocks) == self.blocks_for(t) - 1:
+            for key, b in self._index.items():
+                if len(key) == shared + bt and key[:t] == tuple(prompt):
+                    blocks.append(b)
+                    shared = t
+                    break
+        return blocks, shared
+
+    def admit(self, seq, tokens: int,
+              prompt: Optional[Sequence[int]] = None) -> List[int]:
         """Register sequence `seq` at length `tokens`, allocating its
-        initial block table. Raises KVPoolExhausted (allocating
-        nothing) when the pool cannot hold it."""
+        initial block table. With `prompt` (the token ids), committed
+        prefix blocks are mapped shared instead of freshly allocated —
+        `shared_tokens(seq)` reports how many positions need no
+        prefill. Raises KVPoolExhausted (allocating nothing) when the
+        pool cannot hold the non-shared remainder."""
         if seq in self._tables:
             raise ValueError(f"sequence {seq!r} already admitted")
-        need = self.blocks_for(max(tokens, 1))
+        shared_blocks: List[int] = []
+        shared = 0
+        if prompt is not None:
+            if len(prompt) != tokens:
+                raise ValueError(
+                    f"prompt length {len(prompt)} != tokens {tokens}")
+            shared_blocks, shared = self.match_prefix(prompt)
+        need = self.blocks_for(max(tokens, 1)) - len(shared_blocks)
         if need > len(self._free):
             raise KVPoolExhausted(
                 f"seq {seq!r} needs {need} blocks, {len(self._free)} "
                 f"free of {self.num_blocks}")
-        self._tables[seq] = [self._free.pop() for _ in range(need)]
+        for b in shared_blocks:
+            self._incref(b)
+        self._tables[seq] = list(shared_blocks) + [
+            self._alloc() for _ in range(need)]
         self._lengths[seq] = int(tokens)
+        self._shared[seq] = int(shared)
         self._publish()
         return list(self._tables[seq])
 
-    def grow(self, seq, new_length: int) -> None:
+    def shared_tokens(self, seq) -> int:
+        """Tokens `seq` mapped from the prefix index at admit time."""
+        return self._shared.get(seq, 0)
+
+    def grow(self, seq, new_length: int) -> List[Tuple[int, int]]:
         """Grow `seq`'s table to cover `new_length` tokens (decode
         appends one token per step; the table grows only at block
-        boundaries). Raises KVPoolExhausted with the table unchanged
+        boundaries). The block receiving position ``new_length - 1``
+        is made privately writable — when it is shared or committed,
+        a fresh block is swapped in and the returned (src, dst) list
+        tells the caller which pool-tensor copies to execute BEFORE
+        the append. Raises KVPoolExhausted with the table unchanged
         when the pool is dry — the caller decides eviction policy."""
         table = self._tables[seq]
+        new_length = int(new_length)
         need = self.blocks_for(new_length) - len(table)
-        if need > len(self._free):
+        wi = (new_length - 1) // self.block_tokens
+        cow = (need <= 0 and wi < len(table)
+               and not self._is_private(table[wi]))
+        if max(need, 0) + (1 if cow else 0) > len(self._free):
             raise KVPoolExhausted(
-                f"seq {seq!r} needs {need} more block(s), "
-                f"{len(self._free)} free")
+                f"seq {seq!r} needs {max(need, 0) + (1 if cow else 0)} "
+                f"more block(s), {len(self._free)} free")
         for _ in range(max(need, 0)):
-            table.append(self._free.pop())
-        self._lengths[seq] = int(new_length)
+            table.append(self._alloc())
+        copies: List[Tuple[int, int]] = []
+        if cow:
+            src = table[wi]
+            dst = self._alloc()
+            table[wi] = dst
+            self._decref(src)
+            copies.append((src, dst))
+        self._lengths[seq] = new_length
         self._publish()
+        return copies
+
+    def cow_for_write(self, seq, lo: int, hi: int) -> List[Tuple[int, int]]:
+        """Make every block covering positions [lo, hi) privately
+        writable (chunked prefill resuming at a divergence point
+        writes a whole range at once). Returns the (src, dst)
+        pool-tensor copies to execute BEFORE the write; raises
+        KVPoolExhausted with the tables unchanged when dry."""
+        if hi <= lo:
+            return []
+        table = self._tables[seq]
+        bt = self.block_tokens
+        idxs = [i for i in range(lo // bt, (hi - 1) // bt + 1)
+                if not self._is_private(table[i])]
+        if len(idxs) > len(self._free):
+            raise KVPoolExhausted(
+                f"seq {seq!r} needs {len(idxs)} copy-on-write "
+                f"block(s), {len(self._free)} free")
+        copies: List[Tuple[int, int]] = []
+        for i in idxs:
+            src = table[i]
+            dst = self._alloc()
+            table[i] = dst
+            self._decref(src)
+            copies.append((src, dst))
+        if copies:
+            self._publish()
+        return copies
+
+    def commit_prefix(self, seq, prompt: Sequence[int]) -> None:
+        """Publish `seq`'s fully-prefilled prompt blocks into the
+        prefix index so later admissions can share them. Only full
+        blocks commit — the partial tail keeps receiving decode
+        appends. Idempotent; on a key collision (identical prompt
+        prefilled concurrently) the first writer wins."""
+        table = self._tables[seq]
+        prompt = list(prompt)
+        for i in range(len(prompt) // self.block_tokens):
+            b = table[i]
+            key = tuple(prompt[: (i + 1) * self.block_tokens])
+            if key in self._index or b in self._block_key:
+                continue
+            self._index[key] = b
+            self._block_key[b] = key
 
     def release(self, seq) -> None:
-        """Retire `seq`: every owned block returns to the free list."""
+        """Retire `seq`: drop one reference per owned block; blocks
+        reaching refcount zero return to the free list (and leave the
+        prefix index)."""
         for b in reversed(self._tables.pop(seq)):
-            self._free.append(b)
+            self._decref(b)
         del self._lengths[seq]
+        self._shared.pop(seq, None)
         self._publish()
 
     def length(self, seq) -> int:
@@ -147,20 +306,45 @@ class PagedKVPool:
         return list(self._tables)
 
     def check_invariants(self) -> List[str]:
-        """Allocator health: disjoint ownership, conservation, table
-        sizes consistent with lengths. Empty list == healthy (the
-        serve smoke and tests gate on it)."""
+        """Allocator health: refcount conservation (shared blocks
+        counted once in blocks_in_use), no freed block with refs,
+        prefix-index consistency, table sizes consistent with
+        lengths. Empty list == healthy (the serve smoke and tests
+        gate on it)."""
         out: List[str] = []
-        owned = [b for t in self._tables.values() for b in t]
-        if len(owned) != len(set(owned)):
-            out.append("a block is owned by two sequences")
-        if SCRATCH_BLOCK in owned or SCRATCH_BLOCK in self._free:
+        owned: Dict[int, int] = {}
+        for t in self._tables.values():
+            for b in t:
+                owned[b] = owned.get(b, 0) + 1
+        if owned != self._refs:
+            for b in sorted(set(owned) | set(self._refs)):
+                if owned.get(b, 0) != self._refs.get(b, 0):
+                    out.append(
+                        f"block {b}: {owned.get(b, 0)} owner(s) vs "
+                        f"refcount {self._refs.get(b, 0)}")
+        if len(self._free) != len(set(self._free)):
+            out.append("free list holds a duplicate (double free)")
+        circ = set(self._refs)
+        if circ & set(self._free):
+            out.append("a freed block still has references")
+        if SCRATCH_BLOCK in circ or SCRATCH_BLOCK in self._free:
             out.append("scratch block 0 entered circulation")
-        if sorted(owned + self._free) != list(
+        if sorted(list(circ) + self._free) != list(
                 range(1, self.num_blocks + 1)):
             out.append(
-                f"conservation violated: {len(owned)} owned + "
+                f"conservation violated: {len(circ)} in use + "
                 f"{len(self._free)} free != {self.num_blocks}")
+        for key, b in self._index.items():
+            if self._block_key.get(b) != key:
+                out.append(f"committed block {b}: reverse key mismatch")
+            if b not in circ:
+                out.append(f"committed block {b} not in circulation")
+            if not key or len(key) % self.block_tokens:
+                out.append(f"committed key of {len(key)} tokens is not "
+                           f"block-aligned")
+        for b in self._block_key:
+            if self._index.get(self._block_key[b]) != b:
+                out.append(f"block {b} committed but index disagrees")
         for seq, t in self._tables.items():
             if len(t) != self.blocks_for(max(self._lengths[seq], 1)):
                 out.append(f"seq {seq!r}: table {len(t)} blocks vs "

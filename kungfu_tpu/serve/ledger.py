@@ -238,6 +238,27 @@ class RequestLedger:
                                      len(r.tokens))
         return "ok"
 
+    def append_batch(self, rows: List[Dict],
+                     worker: str = "") -> List[str]:
+        """One control-plane round trip for a whole decode iteration:
+        per-row `append_tokens` semantics ("ok"/"stale"/"done"), plus
+        "error" for a row that would raise (recorded as a violation —
+        one malformed row must not abort its batch-mates' appends).
+        BENCH_r15's inverse np scaling was exactly the per-sequence
+        /serve/append storm this folds into a single POST."""
+        out: List[str] = []
+        for row in rows:
+            try:
+                out.append(self.append_tokens(
+                    int(row["id"]), int(row["pos"]),
+                    [int(t) for t in row.get("tokens", [])],
+                    done=bool(row.get("done", False)), worker=worker))
+            except (KeyError, ValueError, TypeError) as e:
+                with self._mu:
+                    self._violations.append(f"append_batch: {e}")
+                out.append("error")
+        return out
+
     def release(self, rid: int, worker: str = "") -> None:
         """Return a leased request to the queue (eviction/shutdown:
         its tokens stay; a later lease resumes it)."""

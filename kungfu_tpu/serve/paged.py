@@ -9,11 +9,20 @@ the batch is at its own length). This module is the paged replacement:
 - the KV cache is the `serve.kv_cache.PagedKVPool`'s tensors
   ([layers, blocks, block_tokens, heads, head_dim]);
 - each decode step takes per-row block tables + lengths, scatters the
-  new token's k/v at each row's own (block, offset), gathers each
-  row's blocks back into a contiguous view, and masks attention to
-  the row's own visible prefix — vLLM's PagedAttention decode shape,
-  expressed in stock JAX gather/scatter (a Pallas kernel drops in
-  behind the same signature when a TPU session warrants it);
+  new token's k/v at each row's own (block, offset), and attends over
+  the row's own visible prefix — vLLM's PagedAttention decode shape.
+  Two attention paths behind the same signature (``kernel=``): the
+  stock-JAX gather ("functional", the default and the parity oracle)
+  and the fused Pallas kernel (`ops.paged_attn`), which chases the
+  block table with scalar-prefetch index maps instead of
+  materializing the contiguous [T, h, d] re-gather — the
+  `KF_SERVE_KERNEL` knob picks at engine construction;
+- **chunked prefill** (`prefill_chunk`): a long prompt fills its pool
+  blocks KF_SERVE_PREFILL_CHUNK tokens at a time with the decode
+  step's exact numeric recipe, so the engine can interleave admission
+  with decode iterations instead of stalling the running batch behind
+  one long forward (Orca's iteration-level scheduling applied to
+  prefill), and a CoW-shared prefix can skip its chunks entirely;
 - **prefill rides the model itself**: one batched causal forward via
   the model's prefill path fills a dense per-layer cache (which on
   TPU runs the flash VMEM-resident scheme when the config says
@@ -90,7 +99,8 @@ def _layernorm(p, x, dtype, eps: float = 1e-6):
     return (y * p["scale"] + p["bias"]).astype(dtype)
 
 
-def decode_step(cfg, params, pool_k, pool_v, tables, lengths, tokens):
+def decode_step(cfg, params, pool_k, pool_v, tables, lengths, tokens,
+                kernel: str = "functional"):
     """One continuous-batching decode iteration.
 
     - `tables` [B, max_blocks] int32 — each row's block table (unused
@@ -99,7 +109,12 @@ def decode_step(cfg, params, pool_k, pool_v, tables, lengths, tokens):
       incoming token is written at position `lengths[b]` (inactive pad
       rows carry length 0 and a scratch table — their writes land in
       the scratch block and their outputs are ignored);
-    - `tokens` [B] int32 — each row's current input token.
+    - `tokens` [B] int32 — each row's current input token;
+    - `kernel` — "functional" (stock-JAX gather, the parity oracle) or
+      a `ops.paged_attn` scheme ("auto"/"resident"/"stream"): the
+      fused kernel replaces the contiguous re-gather with table-
+      chasing scalar-prefetch DMA. The scatter stays stock JAX either
+      way (one token per row — nothing to fuse).
 
     Returns ``(logits [B, vocab] f32, pool_k, pool_v)``. Rows are
     independent: a row's logits depend only on its own table/length/
@@ -117,6 +132,9 @@ def decode_step(cfg, params, pool_k, pool_v, tables, lengths, tokens):
     off = lengths % bt                      # [B] offset inside it
     visible = (jnp.arange(max_blocks * bt)[None, :]
                <= lengths[:, None])         # positions 0..length incl.
+    if kernel != "functional":
+        from ..ops import paged_attn
+    nbp1 = pool_k.shape[1]                  # pool blocks + scratch
 
     wte = params["wte"]["embedding"].astype(dtype)
     wpe = params["wpe"]["embedding"].astype(dtype)
@@ -130,18 +148,120 @@ def decode_step(cfg, params, pool_k, pool_v, tables, lengths, tokens):
         v = _qkv(a["value"], y, dtype)
         pool_k = pool_k.at[layer, blk, off].set(k)
         pool_v = pool_v.at[layer, blk, off].set(v)
-        # gather each row's blocks into its contiguous [T, h, d] view
-        kk = pool_k[layer][tables].reshape(bsz, max_blocks * bt,
-                                           cfg.num_heads, d)
-        vv = pool_v[layer][tables].reshape(bsz, max_blocks * bt,
-                                           cfg.num_heads, d)
-        # f32 scores/softmax — the model's decode-branch numerics
-        s = jnp.einsum("bnd,btnd->bnt", q.astype(jnp.float32),
+        if kernel != "functional":
+            # the per-layer pool slice rides in as a RESHAPE of the
+            # whole pool (free) + a block_base offset in the index
+            # map — slicing pool_k[layer] would copy the layer's
+            # entire pool into a pallas operand every step
+            kp = pool_k.reshape((cfg.num_layers * nbp1,)
+                                + pool_k.shape[2:])
+            vp = pool_v.reshape((cfg.num_layers * nbp1,)
+                                + pool_v.shape[2:])
+            o = paged_attn.paged_attention(
+                q, kp, vp, tables, lengths,
+                block_base=layer * nbp1,
+                scheme=None if kernel == "auto" else kernel)
+            o = o.astype(dtype)
+        else:
+            # gather each row's blocks into a contiguous [T, h, d] view
+            kk = pool_k[layer][tables].reshape(bsz, max_blocks * bt,
+                                               cfg.num_heads, d)
+            vv = pool_v[layer][tables].reshape(bsz, max_blocks * bt,
+                                               cfg.num_heads, d)
+            # f32 scores/softmax — the model's decode-branch numerics
+            s = jnp.einsum("bnd,btnd->bnt", q.astype(jnp.float32),
+                           kk.astype(jnp.float32)) * (d ** -0.5)
+            s = jnp.where(visible[:, None, :], s,
+                          jnp.finfo(jnp.float32).min)
+            w = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bnt,btnd->bnd", w,
+                           vv.astype(jnp.float32)).astype(dtype)
+        x = x + _attn_out(a["out"], o, dtype)
+        y = _layernorm(p["LayerNorm_1"], x, dtype)
+        y = _dense(p["Dense_0"], y, dtype)
+        y = jax.nn.gelu(y)
+        y = _dense(p["Dense_1"], y, dtype)
+        x = x + y
+    x = _layernorm(params["LayerNorm_0"], x, dtype)
+    logits = _dense(params["lm_head"], x, jnp.float32)
+    return logits, pool_k, pool_v
+
+
+def make_decode_fn(cfg, kernel: str = "functional"):
+    """The jitted decode step for one engine: pools donated (the pool
+    is updated in place across iterations, never copied). The engine
+    always calls it at its full (max_batch, max_blocks) shapes, so
+    every iteration of the serving loop is ONE compiled program
+    regardless of which slots are live. `kernel` is baked in at trace
+    time (the engine resolves the KF_SERVE_KERNEL knob + plan ONCE at
+    construction — see DecodeEngine)."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def fn(params, pool_k, pool_v, tables, lengths, tokens):
+        return decode_step(cfg, params, pool_k, pool_v, tables,
+                           lengths, tokens, kernel=kernel)
+
+    return fn
+
+
+def prefill_chunk(cfg, params, pool_k, pool_v, table, start, tokens,
+                  true_len):
+    """Incremental prefill: run `tokens` [C] (positions ``start ..
+    start+C-1``) of ONE sequence against its pool blocks, with the
+    decode step's exact numeric recipe (f32 scores/softmax, finfo.min
+    masking) applied causally WITHIN the chunk — query i sees pool
+    positions 0..start+i inclusive, its own freshly scattered k/v
+    included. The engine calls this repeatedly to prefill
+    KF_SERVE_PREFILL_CHUNK tokens per iteration, and to prefill only
+    the non-shared remainder of a CoW-shared prefix.
+
+    - `table` [max_blocks] int32 — the sequence's padded block-table
+      row (unused entries point at scratch);
+    - `start` scalar int32 — first position of this chunk (everything
+      before it is already in the pool: earlier chunks or shared
+      blocks);
+    - `true_len` scalar int32 — ``start + real_tokens``; padded tail
+      positions (>= true_len) scatter into the scratch block and mask
+      themselves out of every real query's visibility.
+
+    Returns ``(logits [C, vocab] f32, pool_k, pool_v)`` — the caller
+    reads the last REAL row's argmax when the prompt completes.
+    """
+    _supported(cfg)
+    dtype = cfg.dtype
+    c = tokens.shape[0]
+    max_blocks = table.shape[0]
+    bt = pool_k.shape[2]
+    d = cfg.hidden_size // cfg.num_heads
+    pos = start + jnp.arange(c, dtype=jnp.int32)      # [C]
+    real = pos < true_len
+    blk = jnp.where(real, table[pos // bt], 0)        # pad -> scratch
+    off = pos % bt
+    t = max_blocks * bt
+    # query i sees pool positions 0..pos[i] inclusive
+    visible = (jnp.arange(t)[None, :] <= pos[:, None]) \
+        & real[:, None]
+
+    wte = params["wte"]["embedding"].astype(dtype)
+    wpe = params["wpe"]["embedding"].astype(dtype)
+    x = wte[tokens] + wpe[pos]                        # [C, H]
+    for layer in range(cfg.num_layers):
+        p = params[f"Block_{layer}"]
+        y = _layernorm(p["LayerNorm_0"], x, dtype)
+        a = p["CausalSelfAttention_0"]
+        q = _qkv(a["query"], y, dtype)                # [C, h, d]
+        k = _qkv(a["key"], y, dtype)
+        v = _qkv(a["value"], y, dtype)
+        pool_k = pool_k.at[layer, blk, off].set(k)
+        pool_v = pool_v.at[layer, blk, off].set(v)
+        kk = pool_k[layer][table].reshape(t, cfg.num_heads, d)
+        vv = pool_v[layer][table].reshape(t, cfg.num_heads, d)
+        s = jnp.einsum("cnd,tnd->cnt", q.astype(jnp.float32),
                        kk.astype(jnp.float32)) * (d ** -0.5)
         s = jnp.where(visible[:, None, :], s,
                       jnp.finfo(jnp.float32).min)
         w = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bnt,btnd->bnd", w,
+        o = jnp.einsum("cnt,tnd->cnd", w,
                        vv.astype(jnp.float32)).astype(dtype)
         x = x + _attn_out(a["out"], o, dtype)
         y = _layernorm(p["LayerNorm_1"], x, dtype)
@@ -154,17 +274,64 @@ def decode_step(cfg, params, pool_k, pool_v, tables, lengths, tokens):
     return logits, pool_k, pool_v
 
 
-def make_decode_fn(cfg):
-    """The jitted decode step for one engine: pools donated (the pool
-    is updated in place across iterations, never copied). The engine
-    always calls it at its full (max_batch, max_blocks) shapes, so
-    every iteration of the serving loop is ONE compiled program
-    regardless of which slots are live."""
+def make_prefill_chunk_fn(cfg):
+    """Jitted `prefill_chunk` with the pools donated; the engine caches
+    one per chunk length (chunks are padded to block-sized buckets, so
+    the compile count is bounded like the whole-prefill path's)."""
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def fn(params, pool_k, pool_v, tables, lengths, tokens):
-        return decode_step(cfg, params, pool_k, pool_v, tables,
-                           lengths, tokens)
+    def fn(params, pool_k, pool_v, table, start, tokens, true_len):
+        return prefill_chunk(cfg, params, pool_k, pool_v, table,
+                             start, tokens, true_len)
+
+    return fn
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _copy_blocks(pool_k, pool_v, src, dst):
+    pool_k = pool_k.at[:, dst].set(pool_k[:, src])
+    pool_v = pool_v.at[:, dst].set(pool_v[:, src])
+    return pool_k, pool_v
+
+
+def copy_blocks(pool_k, pool_v, copies):
+    """Apply the allocator's copy-on-write list: ONE donated gather/
+    scatter for all (src, dst) pairs of this iteration, all layers at
+    once — not a Python loop of whole-pool copies."""
+    src = np.asarray([c[0] for c in copies], np.int32)
+    dst = np.asarray([c[1] for c in copies], np.int32)
+    return _copy_blocks(pool_k, pool_v, src, dst)
+
+
+#: per-engine-model jitted whole-prefill (id-keyed: serving owns ONE
+#: long-lived model; jit itself caches per prompt-bucket shape). The
+#: eager model.apply this replaces cost ~50x the compiled forward in
+#: per-op dispatch — it was the prefill_ms dominator of every
+#: BENCH_r16 cell, not the forward's FLOPs.
+_PREFILL_JIT: dict = {}
+
+
+def _make_prefill_fn(model):
+    cfg = model.config
+
+    @jax.jit
+    def fn(params, prompt):
+        abstract = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), prompt[:, :1],
+                               decode=True))
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, prompt, prefill=True,
+            mutable=["cache"])
+        t = prompt.shape[1]
+        ks = jnp.stack([
+            mut["cache"][f"Block_{i}"]["CausalSelfAttention_0"]
+            ["k"][:, :t] for i in range(cfg.num_layers)])
+        vs = jnp.stack([
+            mut["cache"][f"Block_{i}"]["CausalSelfAttention_0"]
+            ["v"][:, :t] for i in range(cfg.num_layers)])
+        return logits.astype(jnp.float32), ks, vs
 
     return fn
 
@@ -174,31 +341,19 @@ def prefill(model, params, prompt):
 
     `prompt` [B, T] int32. Returns ``(logits [B, T, vocab] f32, ks,
     vs)`` with ks/vs [L, B, T, h, d] — the filled cache prefix, ready
-    for `write_prefill` to scatter into pool blocks. One forward,
-    same numerics as `gpt_generate`'s prefill (it IS the same code
-    path). Callers that pad the prompt to a length bucket (the
-    engine does, to bound compile count) read the logits at the last
-    REAL position — causal masking keeps positions < T independent
-    of the padding behind them.
+    for `write_prefill` to scatter into pool blocks. One jitted
+    forward per prompt-bucket shape, same numerics as
+    `gpt_generate`'s prefill (it IS the same code path). Callers that
+    pad the prompt to a length bucket (the engine does, to bound
+    compile count) read the logits at the last REAL position —
+    causal masking keeps positions < T independent of the padding
+    behind them.
     """
     _supported(model.config)
-    cfg = model.config
-    abstract = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), prompt[:, :1],
-                           decode=True))
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
-    logits, mut = model.apply(
-        {"params": params, "cache": cache}, prompt, prefill=True,
-        mutable=["cache"])
-    t = prompt.shape[1]
-    ks = jnp.stack([
-        mut["cache"][f"Block_{i}"]["CausalSelfAttention_0"]["k"][:, :t]
-        for i in range(cfg.num_layers)])
-    vs = jnp.stack([
-        mut["cache"][f"Block_{i}"]["CausalSelfAttention_0"]["v"][:, :t]
-        for i in range(cfg.num_layers)])
-    return logits.astype(jnp.float32), ks, vs
+    fn = _PREFILL_JIT.get(id(model))
+    if fn is None:
+        fn = _PREFILL_JIT[id(model)] = _make_prefill_fn(model)
+    return fn(params, prompt)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))

@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import kungfu_tpu
 from kungfu_tpu import trace
 from kungfu_tpu.elastic import ElasticCallback
-from kungfu_tpu.env import env_float, env_int
+from kungfu_tpu.env import env_choice, env_flag, env_float, env_int
 from kungfu_tpu.ffi import KfError
 from kungfu_tpu.initializer import broadcast_variables
 from kungfu_tpu.serve import frontend
@@ -69,6 +69,13 @@ NUM_BLOCKS = env_int("KF_SERVE_BLOCKS", 0, minimum=0)
 #: until the iteration cap — the benchmark/harness always sets it)
 EXPECT = env_int("KF_SERVE_EXPECT", 0, minimum=0)
 MAX_ITERS = env_int("KF_SERVE_MAX_ITERS", 20_000, minimum=1)
+#: fast-path knobs (docs/serving.md "The fast path"): decode kernel
+#: selection, chunked-prefill size (0 = whole-prompt), CoW prefix
+#: sharing across requests
+KERNEL = env_choice("KF_SERVE_KERNEL", "auto",
+                    ("auto", "kernel", "functional"))
+PREFILL_CHUNK = env_int("KF_SERVE_PREFILL_CHUNK", 0, minimum=0)
+SHARE_PREFIX = env_flag("KF_SERVE_SHARE_PREFIX", True)
 SCHEDULE = os.environ.get("TEST_SCHEDULE", "")
 POLICY = os.environ.get("KF_POLICY", "")
 RECOVER = os.environ.get("KF_RECOVER", "0") == "1"
@@ -158,12 +165,38 @@ elif CKPT_DIR:
 
 engine = DecodeEngine(model, params, max_batch=MAX_BATCH,
                       block_tokens=BLOCK_TOKENS, max_len=MAX_LEN,
-                      num_blocks=NUM_BLOCKS)
+                      num_blocks=NUM_BLOCKS, kernel=KERNEL,
+                      prefill_chunk=PREFILL_CHUNK,
+                      share_prefix=SHARE_PREFIX)
+# compile before READY: a replica that jits on its first lease stalls
+# that request for seconds and contends every peer on a shared host
+_t0 = time.perf_counter()
+engine.warm()
+warm_s = time.perf_counter() - _t0
 #: ledger position each live sequence appends at next
 positions = {}
 served = 0
+#: wall seconds spent in control-plane round trips (lease/append/
+#: stats) — the KF_SERVE_TIMING breakdown the benchmark parses
+control_s = 0.0
+#: high-water mark of KV blocks in use — the prefix-sharing
+#: benchmark cell's collapse observable
+peak_blocks = 0
+
+
+def timed(fn, *args, **kwargs):
+    global control_s
+    t0 = time.perf_counter()
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        control_s += time.perf_counter() - t0
+
+
 print(f"KF_SERVE_READY rank={peer.rank} size={peer.size} "
-      f"max_batch={MAX_BATCH} block_tokens={BLOCK_TOKENS}", flush=True)
+      f"max_batch={MAX_BATCH} block_tokens={BLOCK_TOKENS} "
+      f"kernel={engine.kernel} chunk={PREFILL_CHUNK} "
+      f"share={int(SHARE_PREFIX)}", flush=True)
 
 
 def release_all(note: str) -> None:
@@ -202,10 +235,15 @@ def survivor_recover() -> None:
 
 
 for _ in range(MAX_ITERS):
+    # rows for THIS iteration's single /serve/append_batch round trip
+    # (one POST per iteration instead of one per sequence — the
+    # per-sequence append storm was BENCH_r15's inverse np scaling)
+    rows = []
     # -- admit: fill free slots from the ledger -----------------------------
     if engine.free_slots() > 0:
         try:
-            leased = frontend.lease(url, engine.free_slots(), WID)
+            leased = timed(frontend.lease, url, engine.free_slots(),
+                           WID)
         except (OSError, ValueError, KeyError) as e:
             print(f"[kf-serve] lease failed after bounded retries: "
                   f"{e}", flush=True)
@@ -222,16 +260,23 @@ for _ in range(MAX_ITERS):
                 [int(t) for t in r["tokens"]]
             remaining = int(r["max_new"]) - int(r["pos"])
             if remaining <= 0 or not engine.can_admit(len(prompt)):
-                frontend.release(url, rid, WID)
+                timed(frontend.release, url, rid, WID)
                 continue
             tok, done = engine.admit(rid, prompt, remaining)
+            if tok is None:
+                # deferred (chunked/shared) prefill: step() emits the
+                # first token through its `emitted` map at this pos
+                positions[rid] = int(r["pos"])
+                continue
             positions[rid] = int(r["pos"]) + 1
-            status = frontend.append(url, rid, int(r["pos"]), [tok],
-                                     done, WID)
+            # the one append that stays un-batched: it renews this
+            # request's lease BEFORE the iteration's decode/compile
+            # work (a boot-time compile can outlive the lease, and a
+            # first-iteration "stale" would bounce the whole batch
+            # back to the queue)
+            status = timed(frontend.append, url, rid, int(r["pos"]),
+                           [tok], done, WID)
             if status != "ok":
-                # "stale": our lease was reclaimed; "done": finished
-                # elsewhere while we stalled — either way the
-                # sequence must not occupy a slot here
                 engine.drain(rid)
                 positions.pop(rid, None)
             elif done:
@@ -241,31 +286,43 @@ for _ in range(MAX_ITERS):
     # -- one continuous-batching decode iteration ---------------------------
     emitted, preempted = engine.step()
     for s in preempted:
-        frontend.release(url, int(s), WID)
+        timed(frontend.release, url, int(s), WID)
         positions.pop(s, None)
     for s, (tok, done) in emitted.items():
-        status = frontend.append(url, int(s), positions[s], [tok],
-                                 done, WID)
-        if status != "ok":
-            # "stale": our lease was reclaimed; "done": a resumed
-            # lease finished the request elsewhere while we stalled
-            # (e.g. through a recovery window) — keeping the dead
-            # sequence would burn a batch slot for up to max_new
-            # more iterations
-            engine.drain(s)
-            positions.pop(s, None)
-            continue
+        rows.append({"id": int(s), "pos": positions[s],
+                     "tokens": [tok], "done": done})
         positions[s] = positions[s] + 1
-        if done:
-            served += 1
-            positions.pop(s, None)
+    for s in engine.prefilling():
+        if s not in emitted:
+            # heartbeat: an empty in-place append renews the lease of
+            # a sequence that spends several iterations in chunked
+            # prefill without emitting anything
+            rows.append({"id": int(s), "pos": positions[s],
+                         "tokens": [], "done": False})
+    stats = None
+    if rows:
+        statuses, stats = timed(frontend.append_batch, url, rows, WID)
+        for row, status in zip(rows, statuses):
+            rid = row["id"]
+            if status != "ok":
+                # "stale": our lease was reclaimed; "done": a resumed
+                # lease finished the request elsewhere while we
+                # stalled (e.g. through a recovery window) — keeping
+                # the dead sequence would burn a batch slot for up to
+                # max_new more iterations
+                engine.drain(rid)
+                positions.pop(rid, None)
+            elif row["done"]:
+                served += 1
+                positions.pop(rid, None)
     metrics.REGISTRY.set("kf_serve_active", engine.active)
+    peak_blocks = max(peak_blocks, engine.pool.blocks_in_use)
 
     # -- elastic membership (the training runtime's path, unchanged) --------
     try:
-        stats = None
         if policy is not None:
-            stats = frontend.stats(url)
+            if stats is None:
+                stats = timed(frontend.stats, url)
             policy.observe(stats["queue_depth"], stats["running"],
                            stats["p99_ms"])
         with trace.span("step.hook", cat="serve"):
@@ -302,7 +359,7 @@ for _ in range(MAX_ITERS):
     # -- drain / idle -------------------------------------------------------
     if EXPECT > 0:
         try:
-            stats = stats or frontend.stats(url)
+            stats = stats or timed(frontend.stats, url)
         except (OSError, ValueError, KeyError):
             stats = None
         if stats and stats["done"] + stats["failed"] >= EXPECT:
@@ -312,5 +369,12 @@ for _ in range(MAX_ITERS):
 
 release_all("shutdown")  # no-op on a drained ledger (EXPECT reached);
 #                          an iteration-cap exit returns its leases
+print(f"KF_SERVE_TIMING rank={peer.rank} steps={engine.steps} "
+      f"decode_ms={engine.decode_s * 1e3:.1f} "
+      f"prefill_ms={engine.prefill_s * 1e3:.1f} "
+      f"prefill_chunks={engine.prefill_chunks} "
+      f"control_ms={control_s * 1e3:.1f} "
+      f"warm_ms={warm_s * 1e3:.1f} "
+      f"peak_blocks={peak_blocks}", flush=True)
 print(f"KF_SERVE_DONE rank={peer.rank} size={peer.size} "
       f"served={served} iters={elastic.state.step}", flush=True)

@@ -267,6 +267,37 @@ print(f"SERVE SMOKE OK: {st['done']} requests, "
       f"p99 {st['p99_ms']:.0f} ms through the grow")
 EOF
 
+# the serving fast path (docs/serving.md "The fast path"): the same
+# tier on a prefix-heavy mix (one 48-token common prefix, short
+# unique tails) with CoW prefix sharing + chunked prefill ON —
+# sharing must actually engage (peak KV blocks stay well under the
+# unshared mix's footprint) and every request must still complete
+# with zero ledger violations.
+timeout 400 python - <<'EOF'
+from kungfu_tpu.serve.harness import (SERVE_MARKERS, prefix_requests,
+                                      run_serve_cluster)
+out = run_serve_cluster(
+    prefix_requests(8, prefix_len=48, gen_len=12), start_np=2,
+    warmup=2,
+    extra_env={"KF_SERVE_MAX_BATCH": "4",
+               "KF_SERVE_SHARE_PREFIX": "1",
+               "KF_SERVE_PREFILL_CHUNK": "16"},
+    port_range="26000-26999", timeout=360, markers=SERVE_MARKERS)
+st = out["stats"]
+assert st["failed"] == 0 and st["done"] == 10, st
+import re
+chunks = sum(int(m) for m in
+             re.findall(r"prefill_chunks=(\d+)", out["logs"]))
+peaks = [int(m) for m in
+         re.findall(r"peak_blocks=(\d+)", out["logs"])]
+assert chunks > 0, "chunked prefill never engaged:\n" + out["logs"][-2000:]
+# 4 prompts/worker x 4 blocks each = 16 unshared; sharing keeps the
+# common 3 blocks single-copy per worker
+assert peaks and max(peaks) < 16, (peaks, out["logs"][-2000:])
+print(f"SERVE FAST-PATH SMOKE OK: {st['done']} requests, "
+      f"{chunks} prefill chunks, peak KV blocks {max(peaks)}")
+EOF
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

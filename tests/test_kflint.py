@@ -601,6 +601,22 @@ def test_vmem_quiet_on_real_budget():
     assert vmem_budget.check_fused_ce() == []
 
 
+def test_vmem_paged_decode_fires_under_tiny_budget():
+    # the serving decode kernel's plan grid rides the same contract:
+    # an impossible budget must surface as lint, not a Mosaic OOM
+    findings = vmem_budget.check_paged(budget=1 * 2**20)
+    assert findings, "paged vmem pass silent under an impossible budget"
+    assert all("VMEM estimate" in f.message
+               and "paged_attn.py" in f.path for f in findings)
+
+
+def test_vmem_paged_decode_quiet_on_real_budget():
+    # includes the 8k-context point where the RESIDENT scheme cannot
+    # fit: the plan must have degraded (stream or functional), never
+    # returned an over-budget pick
+    assert vmem_budget.check_paged() == []
+
+
 # -- kfverify: wire-name-determinism -----------------------------------------
 
 #: the PR 5 joiner deadlock, regression-encoded: an instance counter

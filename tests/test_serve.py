@@ -82,6 +82,126 @@ class TestPagedAllocator:
         assert pool_capacity_blocks(2, 33, 16) == 6
 
 
+# -- copy-on-write prefix sharing (pure host-side, no JAX) --------------------
+
+
+class TestCowPrefixSharing:
+    def test_identical_prompt_maps_same_blocks(self):
+        p = PagedKVPool(num_blocks=8, block_tokens=4)
+        prompt = list(range(12))             # 3 full blocks
+        ta = p.admit("a", 12, prompt=prompt)
+        assert p.shared_tokens("a") == 0     # empty index: no donors
+        p.commit_prefix("a", prompt)
+        tb = p.admit("b", 12, prompt=prompt)
+        assert tb == ta                      # the same physical blocks
+        assert p.shared_tokens("b") == 12
+        assert p.blocks_in_use == 3          # shared blocks count once
+        assert p.check_invariants() == []
+
+    def test_partial_last_block_shares_when_donor_extends(self):
+        p = PagedKVPool(num_blocks=8, block_tokens=4)
+        donor = list(range(12))
+        ta = p.admit("a", 12, prompt=donor)
+        p.commit_prefix("a", donor)
+        # 10-token prompt = donor's first 10 tokens: 2 full-block hits
+        # plus the partial third block (the donor's tail past length
+        # 10 is masked, hence invisible to "b")
+        tb = p.admit("b", 10, prompt=donor[:10])
+        assert tb == ta
+        assert p.shared_tokens("b") == 10
+        assert p.blocks_in_use == 3
+        assert p.check_invariants() == []
+
+    def test_grow_cow_diverges_shared_write_target(self):
+        p = PagedKVPool(num_blocks=8, block_tokens=4)
+        donor = list(range(12))
+        ta = p.admit("a", 12, prompt=donor)
+        p.commit_prefix("a", donor)
+        p.admit("b", 10, prompt=donor[:10])  # shares all 3 blocks
+        # position 10 lands in the shared third block: grow must swap
+        # in a private copy and report the pool-tensor copy to run
+        copies = p.grow("b", 11)
+        tb = p.table("b")
+        assert copies == [(ta[2], tb[2])]
+        assert tb[:2] == ta[:2] and tb[2] != ta[2]
+        assert p.blocks_in_use == 4
+        # the donor's block is untouched and still committed
+        assert p.table("a") == ta
+        assert p.check_invariants() == []
+
+    def test_cow_for_write_respects_committed_even_at_refcount_one(self):
+        p = PagedKVPool(num_blocks=8, block_tokens=4)
+        prompt = list(range(8))
+        ta = p.admit("a", 8, prompt=prompt)
+        p.commit_prefix("a", prompt)
+        # sole owner, but committed: a later admission may map the
+        # block at any moment, so an in-place write is forbidden
+        copies = p.cow_for_write("a", 7, 8)
+        assert len(copies) == 1 and copies[0][0] == ta[1]
+        assert p.table("a")[1] != ta[1]
+        assert p.check_invariants() == []
+
+    def test_release_order_conserves_blocks_and_evicts_index(self):
+        p = PagedKVPool(num_blocks=8, block_tokens=4)
+        prompt = list(range(8))
+        ta = p.admit("a", 8, prompt=prompt)
+        p.commit_prefix("a", prompt)
+        p.admit("b", 8, prompt=prompt)
+        # donor retires FIRST: the sharer's references keep the
+        # blocks (and their index entries) alive
+        p.release("a")
+        assert p.blocks_in_use == 2
+        assert p.check_invariants() == []
+        tc = p.admit("c", 8, prompt=prompt)  # still a donor hit
+        assert tc == ta and p.shared_tokens("c") == 8
+        p.release("b")
+        p.release("c")
+        # last reference gone: blocks freed AND evicted from the
+        # index — the next identical prompt must NOT match stale ids
+        assert p.blocks_in_use == 0 and p.free_blocks == 8
+        p.admit("d", 8, prompt=prompt)
+        assert p.shared_tokens("d") == 0
+        assert p.check_invariants() == []
+
+    def test_churn_interleavings_keep_invariants(self):
+        p = PagedKVPool(num_blocks=16, block_tokens=4)
+        donor = list(range(12))
+        p.admit("d0", 12, prompt=donor)
+        p.commit_prefix("d0", donor)
+        live = ["d0"]
+        for i in range(6):
+            s = f"s{i}"
+            p.admit(s, 12, prompt=donor)
+            live.append(s)
+            if i % 2:                        # diverge half of them
+                p.grow(s, 13)
+            if i == 2:
+                p.release(live.pop(0))       # donor leaves mid-churn
+            if i == 4:
+                p.release(live.pop(1))
+            assert p.check_invariants() == [], (i, p.check_invariants())
+        for s in live:
+            p.release(s)
+        assert p.blocks_in_use == 0 and p.free_blocks == 16
+        assert p.check_invariants() == []
+
+    def test_invariant_gate_catches_double_free(self):
+        p = PagedKVPool(num_blocks=4, block_tokens=4)
+        t = p.admit("a", 4)
+        p.release("a")
+        p._free.append(t[0])                 # corrupt: freed twice
+        bad = p.check_invariants()
+        assert any("double free" in m for m in bad), bad
+
+    def test_invariant_gate_catches_freed_block_with_owner(self):
+        p = PagedKVPool(num_blocks=4, block_tokens=4)
+        t = p.admit("a", 4)
+        p._free.append(t[0])                 # corrupt: owned AND free
+        bad = p.check_invariants()
+        assert any("freed block still has references" in m
+                   for m in bad), bad
+
+
 # -- the request ledger -------------------------------------------------------
 
 
@@ -490,6 +610,132 @@ class TestPagedEngine:
         eng.admit("a", [1, 2, 3, 4, 5], 4)
         assert metrics.REGISTRY.read("kf_kv_blocks_in_use") == \
             eng.pool.blocks_in_use > 0
+
+    def test_kernel_bitwise_parity_straddling_block_boundaries(self, lm):
+        """The Pallas paged-decode kernel against the functional
+        gather path, on the SAME pool state, at cache lengths bt-1,
+        bt, bt+1 and 2*bt (every block-boundary straddle): the
+        resident scheme is bitwise identical; the online-softmax
+        stream scheme is allclose with equal argmax."""
+        from kungfu_tpu.serve import paged
+        from kungfu_tpu.serve.engine import DecodeEngine
+
+        model, params = lm
+        eng = DecodeEngine(model, params, max_batch=4,
+                           block_tokens=4, max_len=32)
+        prompts = {"a": [5, 7, 11], "b": [2, 3, 4, 6],
+                   "c": [9, 8, 7, 6, 5], "d": [13] * 8}
+        for s, p in prompts.items():
+            eng.admit(s, p, 8)
+        order = eng.live()
+        tables = eng.pool.batch_tables(order, eng.max_blocks)
+        lengths = eng.pool.batch_lengths(order)
+        tokens = np.array([eng._seqs[s].last_token for s in order],
+                          np.int32)
+        outs = {}
+        for kern in ("functional", "resident", "stream"):
+            o, _, _ = paged.decode_step(
+                model.config, params, eng.pool_k, eng.pool_v,
+                tables, lengths, tokens, kernel=kern)
+            outs[kern] = np.asarray(o)
+        assert np.array_equal(outs["functional"], outs["resident"])
+        np.testing.assert_allclose(outs["stream"], outs["functional"],
+                                   rtol=1e-5, atol=1e-5)
+        assert (outs["stream"].argmax(-1).tolist()
+                == outs["functional"].argmax(-1).tolist())
+
+    def test_kernel_token_parity_end_to_end(self, lm):
+        """Whole generations through the engine with the kernel
+        schemes match the functional path token for token (growth
+        crosses several block boundaries along the way)."""
+        from kungfu_tpu.serve.engine import DecodeEngine
+
+        model, params = lm
+        prompts = {"a": [5, 7, 11], "b": [2, 3, 4, 6],
+                   "c": [9, 8, 7, 6, 5]}
+        ref = _run_engine(
+            DecodeEngine(model, params, max_batch=3, block_tokens=4,
+                         max_len=32), prompts, 6)
+        for kern in ("resident", "stream"):
+            eng = DecodeEngine(model, params, max_batch=3,
+                               block_tokens=4, max_len=32,
+                               kernel=kern)
+            assert _run_engine(eng, prompts, 6) == ref, kern
+            assert eng.pool.check_invariants() == []
+
+    def test_chunked_prefill_token_parity(self, lm):
+        """prefill_chunk splits long prompts across iterations
+        (interleaved with decode); tokens must match whole-prefill
+        admission exactly — and short prompts keep the immediate
+        path, so the two admission styles coexist in one batch."""
+        from kungfu_tpu.serve.engine import DecodeEngine
+
+        model, params = lm
+        prompts = {"a": [5, 7, 11, 13, 17, 19, 23, 29, 31],
+                   "b": [2, 3], "c": [40, 41, 42, 43, 44, 45, 46]}
+        ref = _run_engine(
+            DecodeEngine(model, params, max_batch=4, block_tokens=4,
+                         max_len=32), prompts, 5)
+        eng = DecodeEngine(model, params, max_batch=4, block_tokens=4,
+                           max_len=32, prefill_chunk=4)
+        got = {s: [] for s in prompts}
+        deferred = 0
+        for s, p in prompts.items():
+            tok, _done = eng.admit(s, p, 5)
+            if tok is None:
+                deferred += 1
+            else:
+                got[s].append(tok)
+        assert deferred == 2                 # a and c exceed the chunk
+        for _ in range(64):
+            emitted, preempted = eng.step()
+            assert not preempted
+            for s, (tok, _d) in emitted.items():
+                got[s].append(tok)
+            if not eng.live():
+                break
+        assert got == ref
+        assert eng.prefill_chunks >= 2
+        assert eng.pool.check_invariants() == []
+        assert eng.pool.blocks_in_use == 0
+
+    def test_prefix_sharing_parity_and_block_collapse(self, lm):
+        """Identical prompts admitted with share_prefix map the
+        committed donor blocks instead of re-prefilling: blocks-in-use
+        collapses, the divergent last-position write goes through
+        copy-on-write, and every token still matches the unshared
+        engine bitwise."""
+        from kungfu_tpu.serve.engine import DecodeEngine
+
+        model, params = lm
+        common = [3, 1, 4, 1, 5, 9, 2, 6]    # exactly 2 full blocks
+        prompts = {f"s{i}": list(common) for i in range(3)}
+        ref = _run_engine(
+            DecodeEngine(model, params, max_batch=3, block_tokens=4,
+                         max_len=32), prompts, 5)
+        eng = DecodeEngine(model, params, max_batch=3, block_tokens=4,
+                           max_len=32, share_prefix=True)
+        got = {}
+        tok, _ = eng.admit("s0", prompts["s0"], 5)   # whole prefill
+        got["s0"] = [tok]
+        for s in ("s1", "s2"):
+            tok, _ = eng.admit(s, prompts[s], 5)
+            assert tok is None               # deferred: shared prefix
+            assert eng.pool.shared_tokens(s) == len(common)
+            got[s] = []
+        # both sharers map the donor's 2 blocks: 2 owned blocks total,
+        # not 6 — the collapse the prefix-heavy benchmark cell shows
+        assert eng.pool.blocks_in_use == 2
+        for _ in range(64):
+            emitted, preempted = eng.step()
+            assert not preempted
+            for s, (tok, _d) in emitted.items():
+                got[s].append(tok)
+            if not eng.live():
+                break
+        assert got == ref
+        assert eng.pool.check_invariants() == []
+        assert eng.pool.blocks_in_use == 0   # index evicted on free
 
 
 # -- the /serve front-end on a live config server -----------------------------
