@@ -10,6 +10,8 @@ uses), and is consulted at fixed hook points in the runtime:
 
 - ``on_step(rank, step)``        — ElasticCallback.after_step
 - ``on_http_request(path)``      — elastic/config_server handlers
+- ``on_replica_request(path, replica, role)``
+                                 — elastic/replica.py handlers
 - ``on_control_send(name)``      — ffi.NativePeer.send_control
 - ``on_spawn(rank)``             — run/job.spawn_worker
 
@@ -27,6 +29,8 @@ Schedule format (``KF_CHAOS`` inline JSON, or ``KF_CHAOS_FILE`` path)::
         {"type": "refuse_http", "path": "/put", "count": 3, "status": 503},
         {"type": "delay_http", "path": "/get", "ms": 200, "count": 2},
         {"type": "die_config_server", "after_requests": 10},
+        {"type": "kill_config_replica", "role": "leader",
+         "path": "/addworker"},
         {"type": "drop_control", "name": "update", "count": 1},
         {"type": "delay_control", "name": "update", "ms": 100, "count": 2},
         {"type": "spawn_delay", "rank": 2, "ms": 500, "count": 1},
@@ -85,6 +89,7 @@ _KNOWN_TYPES = {
     "refuse_http",
     "delay_http",
     "die_config_server",
+    "kill_config_replica",
     "drop_control",
     "delay_control",
     "spawn_delay",
@@ -326,6 +331,40 @@ def on_http_request(path: str) -> Optional[Dict]:
     if f is not None:
         _fire("die_config_server", request=idx)
         return {"die": True}
+    return _http_action(sched, idx, path)
+
+
+def on_replica_request(path: str, replica: int, role: str
+                       ) -> Optional[Dict]:
+    """elastic/replica.py handler hook: the single-server actions plus
+    ``kill_config_replica`` — PERMANENT death (``{"kill": True}``; the
+    victim never restarts), distinct from the restart-shaped
+    ``die_config_server``. Matched on the replica index and its role
+    AT REQUEST TIME (``role: "leader"`` kills whoever currently holds
+    the lease — the coordinate of interest for takeover tests, since
+    election order decides which index that is). ONE request-index
+    increment per request; tier-internal replication/vote traffic is
+    intercepted before this hook fires, so a schedule's indices count
+    client requests exactly as they do against a single server."""
+    sched = active()
+    if sched is None:
+        return None
+    idx = sched.next_http_index()
+    f = sched.take(
+        "kill_config_replica", path=path, replica=replica, role=role,
+        _when=lambda f: idx >= int(f.spec.get("after_requests", 0)))
+    if f is not None:
+        _fire("kill_config_replica", path=path, replica=replica,
+              role=role, request=idx)
+        return {"kill": True}
+    return _http_action(sched, idx, path)
+
+
+def _http_action(sched: ChaosSchedule, idx: int,
+                 path: str) -> Optional[Dict]:
+    """delay/refuse logic shared by both HTTP hooks — factored out so
+    each hook claims exactly one request index (a double increment
+    would shift every `after_requests` threshold in the schedule)."""
     # `after_requests` (optional, default 0 = immediately) arms a
     # delay/refuse fault only from that request index on — the knob
     # the scenario compiler lowers a step coordinate to (~1 GET per

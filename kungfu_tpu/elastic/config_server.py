@@ -9,7 +9,10 @@ tests/go/cmd/kungfu-config-server-example/kungfu-config-server-example.go):
 - POST /removeworker  -> shrink by one worker (version++)
 - POST /clear         -> remove all workers (version++)
 - POST /reset         -> restore the initial seeded stage (version++)
-- GET  /stop          -> shut the server down
+- POST /stop          -> shut the server down (GET /stop is a
+                         deprecated alias for one round: a
+                         state-changing GET is exactly the cache-ish
+                         probe shape that must never kill a replica)
 - POST /trace         -> ingest one kftrace event batch (bounded)
 - GET  /trace         -> collected trace snapshot (JSON)
 - *    /serve/*       -> the decode tier's request front-end
@@ -128,6 +131,68 @@ class ConfigServer:
         with self._lock:
             return None if self._stage is None else self._stage.to_json()
 
+    # -- replication surface (overridden by elastic/replica.py) -------------
+    #
+    # The base server is a tier of one: the hooks below are no-ops, so
+    # the single-server deployments of every prior round are untouched.
+    # ReplicaConfigServer overrides them to (a) answer /replica/* RPCs
+    # and redirect follower writes to the leader (`_intercept`), (b)
+    # stamp follower reads as stale (`_read_headers`), (c) push a
+    # state snapshot to followers after every mutation
+    # (`_on_mutation`), and (d) consult the replica-aware chaos hook
+    # (`_chaos_hook`) which adds the permanent `kill_config_replica`
+    # fault on top of the restart-shaped `die_config_server`.
+
+    def _intercept(self, method: str, path: str, body: str):
+        """First crack at any request. Return None to fall through to
+        normal handling, or a (status, body[, headers]) tuple."""
+        return None
+
+    def _read_headers(self) -> dict:
+        """Extra headers for locally-served reads (follower staleness
+        marking)."""
+        return {}
+
+    def _on_mutation(self, kind: str) -> None:
+        """Called after every successful state mutation ("stage",
+        "serve", "trace") — the replication push point."""
+
+    def _chaos_hook(self, path: str):
+        return chaos.on_http_request(path)
+
+    def _chaos_kill(self) -> None:
+        """Permanent death (kill_config_replica) — the base tier-of-one
+        treats it like a crash; the replica subclass never comes back."""
+        self._chaos_die()
+
+    def state_snapshot(self) -> dict:
+        """The full replicated state machine: membership stage (+ the
+        seeded initial for /reset), request ledger, trace store."""
+        with self._lock:
+            stage = None if self._stage is None else self._stage.to_json()
+            initial = None if self._initial is None \
+                else self._initial.to_json()
+        return {
+            "stage": stage,
+            "initial": initial,
+            "ledger": self.serve_ledger.snapshot(),
+            "trace": self.trace_store.snapshot(),
+        }
+
+    def state_restore(self, snap: dict) -> None:
+        """Adopt a leader's snapshot. Idempotent by construction: the
+        stage is a versioned value, the ledger/trace restores are
+        wholesale replacements."""
+        stage = None if snap.get("stage") is None \
+            else Stage.from_json(snap["stage"])
+        initial = None if snap.get("initial") is None \
+            else Stage.from_json(snap["initial"])
+        with self._lock:
+            self._stage = stage
+            self._initial = initial
+        self.serve_ledger.restore(snap["ledger"])
+        self.trace_store.restore(snap["trace"])
+
     # -- http ---------------------------------------------------------------
 
     def _handler(self):
@@ -137,22 +202,44 @@ class ConfigServer:
             def log_message(self, *args):  # quiet
                 pass
 
-            def _reply(self, code: int, body: str = ""):
+            def _reply(self, code: int, body: str = "",
+                       headers: Optional[dict] = None):
                 data = body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _body(self, method: str) -> str:
+                n = int(self.headers.get("Content-Length", 0)) \
+                    if method != "GET" else 0
+                return self.rfile.read(n).decode() if n else ""
+
+            def _intercepted(self, method: str, body: str) -> bool:
+                """Replica-tier first crack: /replica/* RPCs, follower
+                write redirects. Runs before every other dispatch —
+                including the chaos hook, so replication traffic never
+                shifts the control plane's request indices."""
+                out = server._intercept(method, self.path, body)
+                if out is None:
+                    return False
+                self._reply(*out)
+                return True
 
             def _chaos(self) -> bool:
                 """Consult the fault schedule; True when the request was
                 consumed by a fault (refused or the server died)."""
-                action = chaos.on_http_request(self.path)
+                action = server._chaos_hook(self.path)
                 if not action:
                     return False
-                if action.get("die"):
-                    server._chaos_die()
+                if action.get("die") or action.get("kill"):
+                    if action.get("kill"):
+                        server._chaos_kill()  # permanent: no restart
+                    else:
+                        server._chaos_die()
                     # drop the connection WITHOUT a reply: the client
                     # sees a reset, exactly like a real crash mid-request
                     try:
@@ -166,7 +253,7 @@ class ConfigServer:
                     return True
                 return False  # delay faults sleep inside the hook
 
-            def _serve(self, method: str) -> bool:
+            def _serve(self, method: str, body: str) -> bool:
                 """Dispatch /serve/* against the request ledger; True
                 when the request was consumed. Serving plane: no
                 chaos hook (see module docstring), no stage lock."""
@@ -174,33 +261,47 @@ class ConfigServer:
                     return False
                 from kungfu_tpu.serve.frontend import handle_serve
 
-                n = int(self.headers.get("Content-Length", 0)) \
-                    if method != "GET" else 0
-                body = self.rfile.read(n).decode() if n else ""
                 out = handle_serve(server.serve_ledger, method,
                                    self.path, body)
                 if out is None:
                     return False
-                self._reply(*out)
+                code, payload = out
+                if method == "GET":
+                    self._reply(code, payload, server._read_headers())
+                else:
+                    # replicate BEFORE acking: a 200 must mean the
+                    # mutation survives the leader's death, else a
+                    # submit acked an instant before a kill is lost
+                    if code == 200:
+                        server._on_mutation("serve")
+                    self._reply(code, payload)
                 return True
 
             def do_GET(self):
+                if self._intercepted("GET", ""):
+                    return
                 if self.path.startswith("/trace"):
                     # observability plane: no chaos hook (see module
                     # docstring), no stage lock
-                    self._reply(200, server.trace_store.to_json())
+                    self._reply(200, server.trace_store.to_json(),
+                                server._read_headers())
                     return
-                if self._serve("GET"):
+                if self._serve("GET", ""):
                     return
                 if self._chaos():
                     return
                 if self.path.startswith("/get"):
                     body = server.stage_json()
                     if body is None:
-                        self._reply(404, '{"error": "no stage"}')
+                        self._reply(404, '{"error": "no stage"}',
+                                    server._read_headers())
                     else:
-                        self._reply(200, body)
+                        self._reply(200, body, server._read_headers())
                 elif self.path.startswith("/stop"):
+                    # deprecated alias (one round): shutdown is a
+                    # state change and moved to POST /stop
+                    print("[kf-config-server] GET /stop is deprecated; "
+                          "use POST /stop", flush=True)
                     self._reply(200, "{}")
                     threading.Thread(target=server.stop,
                                      daemon=True).start()
@@ -208,11 +309,12 @@ class ConfigServer:
                     self._reply(404, '{"error": "unknown path"}')
 
             def _do_update(self):
-                if self._serve("POST"):
+                body = self._body(self.command)
+                if self._intercepted(self.command, body):
+                    return
+                if self._serve("POST", body):
                     return
                 if self.path.startswith("/trace"):
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(n).decode() if n else ""
                     try:
                         taken = server.trace_store.add_batch(
                             json.loads(body))
@@ -220,12 +322,16 @@ class ConfigServer:
                         self._reply(400,
                                     json.dumps({"error": str(e)}))
                         return
+                    server._on_mutation("trace")  # replicate, THEN ack
                     self._reply(200, json.dumps({"accepted": taken}))
+                    return
+                if self.path.startswith("/stop"):
+                    self._reply(200, "{}")
+                    threading.Thread(target=server.stop,
+                                     daemon=True).start()
                     return
                 if self._chaos():
                     return
-                n = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(n).decode() if n else ""
                 err = None
                 if self.path.startswith("/put"):
                     try:
@@ -245,6 +351,7 @@ class ConfigServer:
                 if err:
                     self._reply(400, json.dumps({"error": err}))
                 else:
+                    server._on_mutation("stage")  # replicate, THEN ack
                     self._reply(200, server.stage_json() or "{}")
 
             do_PUT = _do_update

@@ -1,0 +1,723 @@
+"""Replicated config-server tier: lease-leased leader, primary-backup push.
+
+The single `ConfigServer` has been every subsystem's source of truth
+since PR 2 — membership stage, serve request ledger, trace rendezvous —
+and chaos only ever *restarted* it. This module runs the SAME state
+machine as a 2–3 replica tier that survives permanent loss
+(docs/control_plane.md):
+
+- **Leader lease + monotonic term.** One replica holds the lease and
+  serves writes; it heartbeats followers every lease/4. A follower
+  whose lease view lapses (no heartbeat for its staggered election
+  timeout) stands for election at ``term+1``; a replica grants a vote
+  iff the candidate's term beats both its current term and anything it
+  already voted for. Majority of *responding* replicas wins — see the
+  honesty note below.
+- **Synchronous primary-backup replication.** Every successful
+  mutation (stage write, serve-ledger verb, trace batch) pushes the
+  FULL state snapshot (`ConfigServer.state_snapshot`) to every
+  follower, fenced by ``(term, seq)``. There is no operation log to
+  replay: the stage is version-must-grow, and the ledger/trace
+  restores are wholesale — re-applying any snapshot is idempotent,
+  and the seq rule below makes the newest one win.
+- **Write redirects, stale reads.** A follower answers any write with
+  ``307 Location: <leader>`` (peer.py follows it manually, preserving
+  method+body); during an election it answers 503, which the
+  retrying.py taxonomy already classifies transient — "no leader yet"
+  heals by backoff, not failover. Reads are served locally, marked
+  ``X-KF-Stale: 1`` so a client that cares can tell.
+- **Takeover.** The new leader's state is whatever replication gave it
+  (that is the point); it re-bases every RUNNING serve lease to now
+  (`RequestLedger.renew_leases` — the election window must not mass-
+  reclaim requests whose workers are healthy) and pushes a catch-up
+  snapshot at its new term. ``KF_CP_MTTR`` marker lines anchor the
+  detect → elected → catchup_done decomposition the control-plane
+  benchmark measures.
+
+**Seq convergence without a log**: seq is assigned under the lock and
+the snapshot is built *after* assignment, so a push carrying a higher
+seq also snapshots later — whatever mutation triggered a lower-seq
+push is contained in the highest-seq push a follower ever applies.
+Followers apply only strictly-newer (term, seq); a laggard reports
+``behind`` on heartbeat and receives a fresh full push.
+
+**What this is NOT (Raft honesty, expanded in docs/control_plane.md
+and PAPERS.md):** election counts a majority of replicas that
+*responded*, not of the configured membership — under a symmetric
+partition two leaders can coexist (split brain), which real Raft's
+fixed-quorum rule forbids. There is no persistent term/vote state
+(a full-tier restart forgets its history) and no log-completeness
+voting restriction (a follower that missed the last push can win and
+serve slightly-stale state; the stage's version-must-grow rule then
+rejects stale *writes*, so divergence is bounded to read staleness,
+never version regression). This buys leader failover for the
+single-writer, idempotent-snapshot state machine the repo actually
+has, at ~300 lines instead of a consensus library.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from .. import chaos
+from ..env import env_float
+from .config_server import ConfigServer
+
+#: routes a follower redirects to the leader — everything that mutates
+#: replicated state. /stop and /replica/* are replica-local by design.
+_WRITE_PREFIXES = ("/put", "/addworker", "/removeworker", "/clear",
+                   "/reset", "/serve", "/trace")
+
+
+class _RPCReject(Exception):
+    """A replica answered an internal RPC with an HTTP error status."""
+
+    def __init__(self, status: int, body: Dict):
+        super().__init__(f"replica rpc rejected: {status} {body}")
+        self.status = status
+        self.body = body
+
+
+def _rpc(base: str, path: str, payload: Dict, timeout: float) -> Dict:
+    """Tier-internal RPC: POST JSON to ONE specific replica.
+
+    Deliberately raw urllib, not peer.post_url: replication and votes
+    target a *specific* replica, and the shared verbs would rewrite
+    the URL across KF_CONFIG_SERVERS (failover is exactly wrong here —
+    a vote delivered to a different replica than addressed would
+    corrupt the count). Connection-level failures propagate as
+    OSError for the caller to classify (dead peer => skip/abstain).
+    """
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        # single-shot by contract: each caller (election sweep,
+        # replication push, heartbeat) owns its own cadence and must
+        # never back off inside a lease window; the shared peer.py
+        # wrappers would fail over to a DIFFERENT replica, which is
+        # exactly wrong for a vote/push addressed to this one
+        # kflint: disable=retry-discipline
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read().decode() or "{}")
+        except (ValueError, OSError):
+            body = {}
+        raise _RPCReject(e.code, body) from None
+
+
+class ReplicaConfigServer(ConfigServer):
+    """One member of the replicated config tier.
+
+    Construct + ``start()`` like a ConfigServer, then ``wire(bases)``
+    with the full index-aligned list of replica base URLs (its own
+    included) to begin heartbeating/elections. Unwired, it behaves as
+    a follower with no leader: reads work (stale-marked), writes 503.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 standalone: bool = False, index: int = 0,
+                 lease_ms: Optional[float] = None):
+        super().__init__(host, port, standalone)
+        self.index = int(index)
+        self.lease_ms = float(lease_ms) if lease_ms is not None else \
+            env_float("KF_CONFIG_LEASE_MS", 2000.0, minimum=100.0)
+        self._rlock = threading.Lock()
+        self.term = 0           # kf: guarded_by(_rlock)
+        self.voted_term = 0     # kf: guarded_by(_rlock)
+        # follower | leader | dead
+        self.role = "follower"  # kf: guarded_by(_rlock)
+        self.leader_base = ""   # kf: guarded_by(_rlock) — best known
+        self.seq = 0            # kf: guarded_by(_rlock) — replication seq
+        self._hb_t = time.monotonic()  # kf: guarded_by(_rlock)
+        #: index-aligned replica bases (self included); set by wire()
+        self.peers: List[str] = []
+        self.dead = False
+        #: KF_CP_MTTR anchors (epoch ms) of the most recent transition
+        self.mttr_marks: Dict[str, float] = {}
+        # serializes snapshot restores (decide-then-restore must not
+        # interleave between two concurrent pushes)
+        self._apply_mu = threading.Lock()
+        # jitter source for election timeouts; seeded by index so a
+        # tier cold start resolves the same way every run
+        self._rng = random.Random(0xC0 + self.index)
+        self._stop_monitor = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._unreachable: set = set()
+
+    # -- identity -----------------------------------------------------------
+
+    @property
+    def base(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def status(self) -> Dict:
+        with self._rlock:
+            return {"role": self.role, "term": self.term,
+                    "seq": self.seq, "leader": self.leader_base,
+                    "index": self.index, "base": self.base,
+                    "dead": self.dead}
+
+    # -- wiring -------------------------------------------------------------
+
+    def wire(self, bases: List[str]) -> "ReplicaConfigServer":
+        """Learn the tier membership and start the monitor thread."""
+        if bases[self.index] != self.base:
+            raise ValueError(
+                f"replica {self.index}: peers[{self.index}] is "
+                f"{bases[self.index]!r}, expected own base {self.base!r}")
+        self.peers = list(bases)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name=f"kf-replica-{self.index}",
+            daemon=True)
+        self._monitor.start()
+        return self
+
+    def die(self) -> None:
+        """Permanent death — the ``kill_config_replica`` contract:
+        listener, monitor and role all gone, never restarted (distinct
+        from the restart-shaped `_chaos_die`/`restart` pair)."""
+        self.dead = True
+        with self._rlock:
+            self.role = "dead"
+        self._stop_monitor.set()
+        threading.Thread(target=self.stop, daemon=True).start()
+
+    # -- monitor: heartbeats out (leader) / lease watch (follower) ----------
+
+    def _election_timeout_s(self) -> float:
+        # staggered by index: after a leader death the lowest living
+        # index usually stands first and wins — a deterministic
+        # tiebreak that keeps cold starts and takeovers quick, plus
+        # jitter so candidacies don't land in lockstep
+        base = self.lease_ms * (2.0 + 0.6 * self.index) / 1e3
+        return base + self._rng.random() * self.lease_ms / 5e3
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_monitor.wait(self.lease_ms / 4e3):
+            if self.dead:
+                return
+            with self._rlock:
+                role = self.role
+                since = time.monotonic() - self._hb_t
+            if role == "leader":
+                self._heartbeat()
+            elif since > self._election_timeout_s():
+                self._run_election()
+
+    # -- election -----------------------------------------------------------
+
+    def _run_election(self) -> None:
+        now_ms = time.time() * 1e3
+        with self._rlock:
+            if self.dead or self.role == "leader":
+                return
+            term = self.term + 1
+            self.voted_term = max(self.voted_term, term)  # vote for self
+            self._hb_t = time.monotonic()  # restart the clock either way
+            peers = list(self.peers)
+        # detect == first candidacy after the lease lapsed (takeover
+        # MTTR phase 1); setdefault keeps the FIRST detection if the
+        # election needs several rounds
+        self.mttr_marks.setdefault("detect", now_ms)
+        print(f"KF_CP_MTTR detect t={now_ms:.1f} replica={self.index} "
+              f"term={term}", flush=True)
+        from .. import trace
+
+        trace.event("cp.detect", cat="control_plane",
+                    replica=self.index, term=term)
+        votes = reachable = 1  # self
+        for i, peer_base in enumerate(peers):
+            if i == self.index:
+                continue
+            try:
+                out = _rpc(peer_base, "/replica/vote",
+                           {"term": term, "candidate": self.index,
+                            "base": self.base},
+                           timeout=max(0.5, self.lease_ms / 2e3))
+            except _RPCReject:
+                reachable += 1  # answered (a no is still a voter)
+                continue
+            except (OSError, ValueError):
+                continue  # unreachable: abstains (see module honesty note)
+            reachable += 1
+            if out.get("granted"):
+                votes += 1
+            if int(out.get("term", 0)) > term:
+                with self._rlock:
+                    self.term = max(self.term, int(out.get("term", 0)))
+                return  # someone is ahead; follow them instead
+        if votes >= reachable // 2 + 1:
+            self._become_leader(term)
+        else:
+            with self._rlock:
+                self.term = max(self.term, term)
+
+    def _become_leader(self, term: int) -> None:
+        with self._rlock:
+            if self.dead or term < self.term:
+                return
+            self.term = term
+            self.role = "leader"
+            self.leader_base = self.base
+        now_ms = time.time() * 1e3
+        self.mttr_marks["elected"] = now_ms
+        print(f"KF_CP_MTTR elected t={now_ms:.1f} replica={self.index} "
+              f"term={term}", flush=True)
+        from .. import trace
+
+        trace.event("cp.elected", cat="control_plane",
+                    replica=self.index, term=term)
+        # state catch-up: re-base the serve leases the election window
+        # ate into (their workers are still healthily decoding), then
+        # push a full snapshot at the new term so every follower —
+        # including any that was ahead of US on a lost push — converges
+        renewed = self.serve_ledger.renew_leases()
+        try:
+            self._push_state()
+        except _RPCReject:
+            pass  # fenced already: _push_state stepped us down
+        done_ms = time.time() * 1e3
+        self.mttr_marks["catchup_done"] = done_ms
+        print(f"KF_CP_MTTR catchup_done t={done_ms:.1f} "
+              f"replica={self.index} term={term} "
+              f"renewed_leases={renewed}", flush=True)
+        trace.event("cp.catchup_done", cat="control_plane",
+                    replica=self.index, term=term, renewed=renewed)
+
+    def _step_down(self, term: int) -> None:
+        with self._rlock:
+            self.term = max(self.term, term)
+            if self.role != "leader":
+                return
+            self.role = "follower"
+            self.leader_base = ""
+            self._hb_t = time.monotonic()
+        print(f"[kf-replica] r{self.index} deposed at term {term}; "
+              "following", flush=True)
+
+    # -- replication push (leader side) -------------------------------------
+
+    def _on_mutation(self, kind: str) -> None:
+        with self._rlock:
+            if self.role != "leader":
+                return
+        self._push_state()
+
+    def _push_state(self) -> None:
+        # seq assigned under the lock, snapshot built AFTER — a push
+        # with a higher seq therefore snapshots later, so the highest
+        # seq a follower applies contains every mutation that
+        # triggered a lower one (module docstring: convergence)
+        with self._rlock:
+            if self.role != "leader":
+                return
+            self.seq += 1
+            term, seq = self.term, self.seq
+            peers = list(self.peers)
+        payload = {"term": term, "seq": seq, "leader": self.base,
+                   "state": self.state_snapshot()}
+        fenced = 0
+        for i, peer_base in enumerate(peers):
+            if i == self.index:
+                continue
+            try:
+                _rpc(peer_base, "/replica/apply", payload,
+                     timeout=max(0.5, self.lease_ms / 1e3))
+                self._mark_reachable(i)
+            except _RPCReject as e:
+                if e.status == 409:  # term fencing: we are deposed
+                    fenced = max(fenced, int(e.body.get("term", term)))
+            except (OSError, ValueError):
+                # dead or slow follower: it reports `behind` on the
+                # next heartbeat it answers and gets a fresh push then
+                self._mark_unreachable(i)
+        if fenced:
+            self._step_down(fenced)
+
+    def _heartbeat(self) -> None:
+        with self._rlock:
+            if self.role != "leader":
+                return
+            term, seq = self.term, self.seq
+            peers = list(self.peers)
+        behind = False
+        for i, peer_base in enumerate(peers):
+            if i == self.index:
+                continue
+            try:
+                out = _rpc(peer_base, "/replica/heartbeat",
+                           {"term": term, "seq": seq,
+                            "leader": self.base},
+                           timeout=max(0.5, self.lease_ms / 2e3))
+                self._mark_reachable(i)
+                if out.get("behind"):
+                    behind = True
+            except _RPCReject as e:
+                if e.status == 409:
+                    self._step_down(int(e.body.get("term", term)))
+                    return
+            except (OSError, ValueError):
+                self._mark_unreachable(i)
+        if behind:
+            self._push_state()
+
+    def _mark_unreachable(self, i: int) -> None:
+        if i not in self._unreachable:
+            self._unreachable.add(i)
+            print(f"[kf-replica] r{self.index}: replica {i} "
+                  "unreachable; continuing without it", flush=True)
+
+    def _mark_reachable(self, i: int) -> None:
+        if i in self._unreachable:
+            self._unreachable.discard(i)
+            print(f"[kf-replica] r{self.index}: replica {i} back",
+                  flush=True)
+
+    # -- request interception (follower redirects + replica RPCs) -----------
+
+    def _intercept(self, method: str, path: str, body: str):
+        if path.startswith("/replica/"):
+            return self._replica_rpc(path, body)
+        if method == "GET" or path.startswith("/stop"):
+            return None  # reads serve locally (stale-marked); stop local
+        with self._rlock:
+            role, leader, term = self.role, self.leader_base, self.term
+            # only vouch for a leader we heard from within the lease
+            # window: redirecting clients at a corpse until our own
+            # election timeout fires would burn their whole retry
+            # budget on connection-refused hops — a 503 is transient
+            # to the shared policy and heals by backoff instead
+            fresh = (time.monotonic() - self._hb_t
+                     ) <= 2.0 * self.lease_ms / 1e3
+        if role == "leader":
+            return None
+        if not path.startswith(_WRITE_PREFIXES):
+            return None  # unknown paths 404 locally
+        if leader and leader != self.base and fresh:
+            return (307, json.dumps({"leader": leader}),
+                    {"Location": leader + path})
+        return (503, json.dumps({
+            "error": f"no live leader (election in progress, "
+                     f"term {term})"}))
+
+    def _replica_rpc(self, path: str, body: str):
+        try:
+            msg = json.loads(body) if body else {}
+        except ValueError:
+            return (400, '{"error": "bad replica rpc body"}')
+        if path.startswith("/replica/vote"):
+            return self._on_vote(msg)
+        if path.startswith("/replica/apply"):
+            return self._on_apply(msg)
+        if path.startswith("/replica/heartbeat"):
+            return self._on_heartbeat(msg)
+        if path.startswith("/replica/status"):
+            return (200, json.dumps(self.status()))
+        return (404, '{"error": "unknown replica rpc"}')
+
+    def _on_vote(self, msg: Dict):
+        req_term = int(msg.get("term", 0))
+        with self._rlock:
+            granted = req_term > max(self.term, self.voted_term)
+            if granted:
+                self.voted_term = req_term
+                self._hb_t = time.monotonic()  # give the candidate room
+                if self.role == "leader":
+                    # a follower stopped hearing us; let the higher
+                    # term win rather than split the tier
+                    self.role = "follower"
+                    self.leader_base = ""
+            self.term = max(self.term, req_term)
+            term = self.term
+        return (200, json.dumps({"granted": granted, "term": term}))
+
+    def _on_apply(self, msg: Dict):
+        req_term = int(msg.get("term", 0))
+        req_seq = int(msg.get("seq", 0))
+        with self._apply_mu:  # serialize decide-then-restore
+            with self._rlock:
+                if req_term < self.term:
+                    return (409, json.dumps(
+                        {"error": "stale term", "term": self.term}))
+                newer_term = req_term > self.term
+                self.term = req_term
+                if self.role == "leader" and \
+                        str(msg.get("leader", "")) != self.base:
+                    self.role = "follower"
+                self.leader_base = str(msg.get("leader", ""))
+                self._hb_t = time.monotonic()
+                if not newer_term and req_seq <= self.seq:
+                    # duplicate or out-of-order push within the same
+                    # term: the state we hold is at least as new
+                    return (200, json.dumps({"ok": True,
+                                             "seq": self.seq}))
+                # a NEW term restarts the seq domain (the new leader
+                # counts from its own replicated seq) — apply it
+                self.seq = req_seq
+            self.state_restore(msg["state"])
+        return (200, json.dumps({"ok": True, "seq": req_seq}))
+
+    def _on_heartbeat(self, msg: Dict):
+        req_term = int(msg.get("term", 0))
+        with self._rlock:
+            if req_term < self.term:
+                return (409, json.dumps(
+                    {"error": "stale term", "term": self.term}))
+            self.term = req_term
+            if self.role == "leader" and \
+                    str(msg.get("leader", "")) != self.base:
+                self.role = "follower"
+            if self.role != "leader":
+                self.leader_base = str(msg.get("leader", ""))
+                self._hb_t = time.monotonic()
+            behind = self.seq < int(msg.get("seq", 0))
+        return (200, json.dumps({"behind": behind, "term": req_term}))
+
+    # -- read staleness + chaos ---------------------------------------------
+
+    def _read_headers(self) -> dict:
+        with self._rlock:
+            if self.role == "leader":
+                return {}
+            return {"X-KF-Stale": "1", "X-KF-Role": self.role,
+                    "X-KF-Term": str(self.term)}
+
+    def _chaos_hook(self, path: str):
+        with self._rlock:
+            role = self.role
+        return chaos.on_replica_request(path, replica=self.index,
+                                        role=role)
+
+    def _chaos_kill(self) -> None:
+        if self.standalone:
+            os._exit(23)  # abrupt AND permanent: nobody restarts us
+        self.die()
+
+
+class _TierLedgerClient:
+    """RequestLedger look-alike for `run_serve_cluster`'s feeder, with
+    every call an HTTP round trip against the tier. Direct in-process
+    ledger calls would bypass replication — a submit living only in
+    the leader's memory dies with it, which is the exact loss the tier
+    exists to prevent. Reads (stats/result/invariants) are served by
+    any live replica (stale-marked); writes ride the redirect/503
+    protocol, retried here until the election resolves."""
+
+    def __init__(self, tier: "ReplicaTier"):
+        self._tier = tier
+
+    def _call(self, fn, deadline_s: float = 30.0):
+        last: Optional[BaseException] = None
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            for r in self._tier.replicas:
+                if r.dead:
+                    continue
+                try:
+                    return fn(r.get_url)
+                except urllib.error.HTTPError as e:
+                    # 503 = election in progress, 429 = admission
+                    # backpressure: wait them out on the next lap
+                    if e.code not in (503, 429):
+                        raise
+                    last = e
+                except (OSError, ValueError) as e:
+                    last = e  # dead/garbled replica: try a sibling
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"no replica answered within {deadline_s}s: {last}")
+
+    def submit(self, prompt, max_new):
+        from ..retrying import NO_RETRY
+        from ..serve import frontend
+
+        return self._call(lambda url: frontend.submit(
+            url, prompt, max_new, retry=NO_RETRY))
+
+    def stats(self):
+        from ..retrying import NO_RETRY
+        from ..serve import frontend
+
+        return self._call(lambda url: frontend.stats(
+            url, retry=NO_RETRY))
+
+    def result(self, rid):
+        from ..retrying import NO_RETRY
+        from ..serve import frontend
+
+        return self._call(lambda url: frontend.result(
+            url, rid, retry=NO_RETRY))
+
+    def check_invariants(self):
+        from ..retrying import NO_RETRY
+        from ..serve import frontend
+
+        return self._call(lambda url: frontend.invariants(
+            url, retry=NO_RETRY))
+
+    # the harness applies scenario env ledger knobs through these —
+    # propagate to every replica so a takeover keeps the setting
+    @property
+    def lease_ms(self):
+        return self._tier.replicas[0].serve_ledger.lease_ms
+
+    @lease_ms.setter
+    def lease_ms(self, v):
+        for r in self._tier.replicas:
+            r.serve_ledger.lease_ms = v
+
+    @property
+    def max_queue(self):
+        return self._tier.replicas[0].serve_ledger.max_queue
+
+    @max_queue.setter
+    def max_queue(self, v):
+        for r in self._tier.replicas:
+            r.serve_ledger.max_queue = v
+
+
+class ReplicaTier:
+    """An in-process replica tier on ephemeral ports — the test,
+    benchmark and smoke instrument (standalone multi-process replicas
+    use `python -m kungfu_tpu.elastic.replica` per member instead).
+
+    Quacks enough like a ConfigServer (`get_url`, `serve_ledger`,
+    `_resize`, `stop`) that `serve.harness.run_serve_cluster` drives a
+    real decode cluster against it unchanged."""
+
+    def __init__(self, n: int = 3, lease_ms: float = 500.0,
+                 host: str = "127.0.0.1"):
+        self.replicas = [
+            ReplicaConfigServer(host=host, index=i,
+                                lease_ms=lease_ms).start()
+            for i in range(n)
+        ]
+        self.bases = [r.base for r in self.replicas]
+        for r in self.replicas:
+            r.wire(self.bases)
+
+    def env(self) -> Dict[str, str]:
+        """The client-side failover config (KF_CONFIG_SERVERS)."""
+        return {"KF_CONFIG_SERVERS": ",".join(self.bases)}
+
+    def leader(self) -> Optional[ReplicaConfigServer]:
+        """The live replica claiming leadership at the highest term
+        (a just-deposed leader can claim it a beat longer)."""
+        best = None
+        for r in self.replicas:
+            if r.dead:
+                continue
+            st = r.status()
+            if st["role"] == "leader" and \
+                    (best is None or st["term"] > best.status()["term"]):
+                best = r
+        return best
+
+    def wait_leader(self, timeout_s: float = 30.0
+                    ) -> ReplicaConfigServer:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            cur = self.leader()
+            if cur is not None:
+                return cur
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"no leader within {timeout_s}s: "
+            f"{[r.status() for r in self.replicas]}")
+
+    def kill_leader(self) -> ReplicaConfigServer:
+        """Permanently kill the current leader; returns the victim."""
+        victim = self.wait_leader()
+        victim.die()
+        return victim
+
+    def stage_versions(self) -> List[Optional[int]]:
+        """Each live replica's local stage version (None = unseeded)."""
+        out: List[Optional[int]] = []
+        for r in self.replicas:
+            if r.dead:
+                continue
+            body = r.stage_json()
+            out.append(None if body is None
+                       else int(json.loads(body)["version"]))
+        return out
+
+    # -- ConfigServer-compatible surface for run_serve_cluster --------------
+
+    @property
+    def get_url(self) -> str:
+        return self.wait_leader().get_url
+
+    @property
+    def serve_ledger(self) -> _TierLedgerClient:
+        return _TierLedgerClient(self)
+
+    def _resize(self, delta: int) -> Optional[str]:
+        """Grow/shrink via HTTP like an operator would — through the
+        redirect/failover protocol, NOT a direct method call (the
+        mid-resize chaos kill fires on exactly this request)."""
+        from ..peer import post_url
+        from ..retrying import NO_RETRY
+
+        route = "/addworker" if delta > 0 else "/removeworker"
+        deadline = time.monotonic() + 30.0
+        last: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            for r in self.replicas:
+                if r.dead:
+                    continue
+                try:
+                    post_url(r.base + route, "{}", retry=NO_RETRY)
+                    return None
+                # any failure shape (307 dead-end, 503 election, conn
+                # refused) means "try the next replica / next lap";
+                # the terminal report below carries the last error
+                # kflint: disable=retry-discipline
+                except Exception as e:  # noqa: BLE001
+                    last = e
+            time.sleep(0.1)
+        return f"{route} failed on every replica: {last}"
+
+    def stop(self) -> None:
+        for r in self.replicas:
+            r._stop_monitor.set()
+        for r in self.replicas:
+            r.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="one standalone config-tier replica")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--index", type=int, required=True)
+    ap.add_argument("--peers", required=True,
+                    help="comma-separated base URLs, index-aligned "
+                         "(this replica's own base included)")
+    ap.add_argument("--lease-ms", type=float, default=None)
+    args = ap.parse_args(argv)
+    server = ReplicaConfigServer(
+        args.host, args.port, standalone=True, index=args.index,
+        lease_ms=args.lease_ms).start()
+    server.wire([b.strip().rstrip("/") for b in args.peers.split(",")])
+    print(f"[kf-replica] r{args.index} serving on {server.base}",
+          flush=True)
+    try:
+        server._thread.join()
+    except KeyboardInterrupt:
+        server.die()
+
+
+if __name__ == "__main__":
+    main()

@@ -23,8 +23,15 @@ PARENT_ID = "KF_PARENT_ID"
 INIT_CLUSTER_VERSION = "KF_INIT_CLUSTER_VERSION"
 ALLREDUCE_STRATEGY = "KF_ALLREDUCE_STRATEGY"
 CONFIG_SERVER = "KF_CONFIG_SERVER"
+CONFIG_SERVERS = "KF_CONFIG_SERVERS"
 # user-tunable runtime config (forwarded by the launcher if set)
 CONFIG_VARS = (
+    # replicated control plane (docs/control_plane.md): the full
+    # replica tier as base URLs — every peer.py HTTP verb fails over
+    # across this list and follows follower->leader 307 redirects;
+    # KF_CONFIG_LEASE_MS is the leader lease (election timeout scale)
+    "KF_CONFIG_SERVERS",
+    "KF_CONFIG_LEASE_MS",
     "KF_LOG_LEVEL",
     "KF_STALL_DETECTION",
     "KF_TIMEOUT_MS",
@@ -188,6 +195,36 @@ def env_flag(name: str, default: bool = False,
     return raw == "1"
 
 
+def env_server_list(name: str,
+                    environ: Optional[Dict[str, str]] = None) -> tuple:
+    """Parse a comma-separated list of config-server BASE URLs
+    (``http://host:port``) with the same loud-at-parse-time contract
+    as the other env_* validators. Entries must be bare bases — the
+    client appends route paths (/get, /put, /serve/...) itself, so a
+    pasted ``.../get`` is an error here, not a silently dead replica.
+    Unset or empty -> empty tuple (single-server mode, no failover)."""
+    from urllib.parse import urlsplit
+
+    e = os.environ if environ is None else environ
+    raw = e.get(name, "")
+    if raw == "":
+        return ()
+    out = []
+    for entry in raw.split(","):
+        entry = entry.strip().rstrip("/")
+        parts = urlsplit(entry)
+        if (parts.scheme not in ("http", "https") or not parts.netloc
+                or parts.path or parts.query or parts.fragment):
+            raise ValueError(
+                f"{name}: bad entry {entry!r} — want "
+                "http://host:port[,http://host:port...] (base URLs, "
+                "no path)")
+        out.append(f"{parts.scheme}://{parts.netloc}")
+    if len(set(out)) != len(out):
+        raise ValueError(f"{name}={raw!r} lists a replica twice")
+    return tuple(out)
+
+
 def env_choice(name: str, default: str, choices,
                environ: Optional[Dict[str, str]] = None) -> str:
     """Parse an enum-valued KF_* variable with a clear error naming the
@@ -261,6 +298,9 @@ def from_env(environ: Optional[Dict[str, str]] = None) -> Config:
                ("auto", "kernel", "functional"), e)
     env_int("KF_SERVE_PREFILL_CHUNK", 0, e, minimum=0)
     env_flag("KF_SERVE_SHARE_PREFIX", True, e)
+    # replicated control plane (docs/control_plane.md)
+    env_server_list(CONFIG_SERVERS, e)
+    env_float("KF_CONFIG_LEASE_MS", 2000.0, e, minimum=100.0)
     self_spec = e.get(SELF_SPEC, "")
     if not self_spec:
         solo = PeerID.from_host("127.0.0.1", 0)

@@ -11,7 +11,10 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
+import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Optional, Tuple
 
@@ -50,6 +53,124 @@ class Stage:
         return self.version.to_bytes(4, "little") + self.cluster.to_bytes()
 
 
+# -- replica-aware HTTP verbs (docs/control_plane.md) ------------------------
+#
+# With KF_CONFIG_SERVERS set, every consumer of fetch_url/put_url/
+# post_url — resize polls, watcher recovery proposals, serve workers,
+# TraceShipper, SLOPolicy stats — gains replica failover WITHOUT
+# per-call-site changes: a URL whose scheme://netloc matches one of the
+# listed replica bases is retargeted across the tier. Two mechanisms,
+# both inside one HTTP *attempt* (the caller's RetryPolicy still owns
+# backoff between attempts):
+#
+# - **307 following**: a follower redirects writes to the leader;
+#   urllib's redirect handler refuses to re-send a body on 307, so the
+#   hop is followed manually (bounded), preserving method + body.
+# - **candidate rotation**: a connection-LEVEL failure (refused/reset/
+#   timeout — retrying.is_conn_failure) moves to the next replica; an
+#   HTTP-level error (e.g. 503 mid-election) raises to the retry
+#   policy, whose backoff is the right medicine for "no leader yet".
+#
+# The last replica that actually answered (post-redirect, so usually
+# the leader) is remembered and tried first next time.
+
+_MAX_REDIRECT_HOPS = 4
+_replica_mu = threading.Lock()
+_preferred_replica = ""  # kf: guarded_by(_replica_mu)
+
+
+def _replica_bases() -> tuple:
+    """The configured replica tier (validated bases), or ()."""
+    return kfenv.env_server_list(kfenv.CONFIG_SERVERS)
+
+
+def _url_base(url: str) -> str:
+    parts = urllib.parse.urlsplit(url)
+    return f"{parts.scheme}://{parts.netloc}"
+
+
+def _failover_candidates(url: str) -> list:
+    """URLs to try for one attempt, preferred replica first. A URL
+    outside the configured tier (file://, a worker's own front-end)
+    passes through untouched."""
+    bases = _replica_bases()
+    if not bases:
+        return [url]
+    base = _url_base(url)
+    if base not in bases:
+        return [url]
+    with _replica_mu:
+        preferred = _preferred_replica
+    order = [base] + [b for b in bases if b != base]
+    if preferred in order and preferred != base:
+        order.remove(preferred)
+        order.insert(0, preferred)
+    suffix = url[len(base):]
+    return [b + suffix for b in order]
+
+
+def _remember_replica(url: str) -> None:
+    global _preferred_replica
+    base = _url_base(url)
+    if base in _replica_bases():
+        with _replica_mu:
+            _preferred_replica = base
+
+
+def _open_following_redirects(url: str, method: str,
+                              body: Optional[bytes],
+                              timeout: float):
+    """urlopen that follows same-method 307/308 hops (the follower→
+    leader write-redirect contract). Returns (final_url, response)."""
+    target = url
+    for _ in range(_MAX_REDIRECT_HOPS):
+        headers = {"Content-Type": "application/json"} \
+            if body is not None else {}
+        req = urllib.request.Request(target, data=body, method=method,
+                                     headers=headers)
+        try:
+            return target, urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            loc = e.headers.get("Location") if e.code in (307, 308) \
+                else None
+            if not loc:
+                raise
+            e.close()
+            target = urllib.parse.urljoin(target, loc)
+    raise urllib.error.HTTPError(
+        target, 508, "redirect loop across config replicas", None, None)
+
+
+def _control_request(url: str, method: str = "GET",
+                     body: Optional[str] = None,
+                     timeout: float = 5.0) -> str:
+    """ONE attempt against the config tier: rotate candidates on
+    connection-level failure, follow write redirects, remember who
+    answered. Raises the last error when every replica is down — the
+    caller's RetryPolicy classifies and backs off from there."""
+    if url.startswith("file://"):  # tests feed stages from disk
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    data = body.encode() if body is not None else None
+    candidates = _failover_candidates(url)
+    last: Optional[BaseException] = None
+    for i, candidate in enumerate(candidates):
+        try:
+            final, resp = _open_following_redirects(
+                candidate, method, data, timeout)
+            with resp:
+                out = resp.read().decode()
+            _remember_replica(final)
+            return out
+        except Exception as e:  # noqa: BLE001 — split below
+            if i + 1 < len(candidates) and retrying.is_conn_failure(e):
+                last = e
+                continue  # this replica is unreachable; try a sibling
+            raise
+    assert last is not None
+    raise last
+
+
 def fetch_url(url: str, timeout: float = 5.0,
               retry: Optional[retrying.RetryPolicy] = None) -> str:
     """GET text from http(s):// or file:// URLs (tests use file://).
@@ -57,13 +178,13 @@ def fetch_url(url: str, timeout: float = 5.0,
     Goes through the shared control-plane retry policy (transient
     faults backed off and logged, permanent ones raised immediately);
     pass ``retrying.NO_RETRY`` for single-shot semantics when the
-    caller owns its own poll loop."""
+    caller owns its own poll loop. Replica-aware when
+    KF_CONFIG_SERVERS is set (see above)."""
     if retry is None:
         retry = retrying.control_plane_policy(name=f"GET {url}")
 
     def _get() -> str:
-        with urllib.request.urlopen(url, timeout=timeout) as r:
-            return r.read().decode()
+        return _control_request(url, "GET", None, timeout)
 
     return retry.run(_get)
 
@@ -74,11 +195,7 @@ def put_url(url: str, body: str, timeout: float = 5.0,
         retry = retrying.control_plane_policy(name=f"PUT {url}")
 
     def _put() -> None:
-        req = urllib.request.Request(
-            url, data=body.encode(), method="PUT",
-            headers={"Content-Type": "application/json"},
-        )
-        urllib.request.urlopen(req, timeout=timeout).read()
+        _control_request(url, "PUT", body, timeout)
 
     retry.run(_put)
 
@@ -94,12 +211,7 @@ def post_url(url: str, body: str, timeout: float = 5.0,
         retry = retrying.control_plane_policy(name=f"POST {url}")
 
     def _post() -> str:
-        req = urllib.request.Request(
-            url, data=body.encode(), method="POST",
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.read().decode()
+        return _control_request(url, "POST", body, timeout)
 
     return retry.run(_post)
 

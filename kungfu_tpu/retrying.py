@@ -48,6 +48,22 @@ def is_transient(exc: BaseException) -> bool:
     return False  # ValueError/KeyError etc.: malformed input never heals
 
 
+def is_conn_failure(exc: BaseException) -> bool:
+    """True for connection-LEVEL failures: refused, reset, timeout, DNS
+    — the server never answered. This is the replica-failover signal
+    (peer.py): when one config replica cannot be reached at all, a
+    sibling may still answer, so the client rotates within the same
+    attempt. An HTTP-level error (the server answered with a status) is
+    NOT a failover signal — a 503 mid-election heals by *waiting* (the
+    retry policy's backoff), not by asking another follower, and a 4xx
+    would be identical everywhere."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return False  # must precede URLError: HTTPError subclasses it
+    if isinstance(exc, urllib.error.URLError):
+        return True
+    return isinstance(exc, (ConnectionError, TimeoutError, OSError))
+
+
 @dataclass
 class RetryPolicy:
     """Bounded retry loop with jittered exponential backoff.

@@ -220,10 +220,18 @@ def resolve_peers_via_http(
             for host, port in list(pending.items()):
                 try:
                     # single-shot fetch (this loop owns the backoff);
-                    # the shared wrapper keeps the taxonomy in one place
-                    body = fetch_url(f"http://{host}:{port}/resolve",
-                                     timeout=2, retry=NO_RETRY)
-                    out[host] = parse_ipv4(body.strip())
+                    # the shared wrapper keeps the taxonomy in one
+                    # place. NOT named `body`: that closure variable is
+                    # what our own /resolve handler serves, and
+                    # rebinding it here to the fetched str made the
+                    # handler crash mid-reply (bytes expected) for any
+                    # peer polling us AFTER our first successful fetch
+                    # — the load-dependent ordering behind the flaky
+                    # two-runner test (regression-pinned in
+                    # tests/test_discovery.py).
+                    answer = fetch_url(f"http://{host}:{port}/resolve",
+                                       timeout=2, retry=NO_RETRY)
+                    out[host] = parse_ipv4(answer.strip())
                     del pending[host]
                 except OSError:  # URLError/HTTPError both subclass it
                     pass

@@ -43,6 +43,9 @@ Lowering rules:
   a request-index threshold derived as ``step * np0`` (the elastic
   hook polls the server about once per step per rank — the one
   documented approximation in the lowering, recorded on the plan).
+- ``kill_replica`` -> a ``kill_config_replica`` fault (permanent
+  replica death, docs/control_plane.md) with the same ``step * np0``
+  request-index threshold, matched on ``role``/``replica``/``path``.
 - ``partition`` -> netns link-flap windows on the plan (the FakeNet
   fabric applies them by wall offset; chaos-matrix only).
 """
@@ -216,6 +219,22 @@ def compile_scenario(scenario) -> ScenarioPlan:
                 f"flaky_control step {ev['step']} lowered to "
                 f"after_requests={fault['after_requests']} "
                 f"(~1 GET/step/rank)")
+        elif kind == "kill_replica":
+            fault = {
+                "type": "kill_config_replica",
+                "role": str(ev.get("role", "leader")),
+                "after_requests": int(ev["step"]) * scenario.np0,
+            }
+            if ev.get("replica") is not None:
+                fault["replica"] = int(ev["replica"])
+            if ev.get("path") is not None:
+                fault["path"] = str(ev["path"])
+            faults.append((int(ev["step"]), fault))
+            notes.append(
+                f"kill_replica step {ev['step']} lowered to "
+                f"after_requests={fault['after_requests']} "
+                f"(permanent {fault['role']} death; fires only when "
+                "the replay runs the replicated tier)")
         elif kind == "partition":
             netns.append((str(ev["host"]), float(ev["at_ms"]),
                           float(ev["heal_ms"])))
@@ -253,7 +272,8 @@ def compile_scenario(scenario) -> ScenarioPlan:
         # is one occurrence.)
         bounds = sorted(int(e["step"]) for e in cluster_preempts)
         for anchor, f in faults:
-            if (f["type"] in ("delay_http", "refuse_http")
+            if (f["type"] in ("delay_http", "refuse_http",
+                              "kill_config_replica")
                     and anchor > bounds[0]):
                 raise ValueError(
                     f"scenario {scenario.name!r}: flaky_control at "

@@ -27,6 +27,8 @@ Spec format::
         "duration_steps": 4, "ms": 120},
        {"kind": "flaky_control", "step": 3, "requests": 4,
         "mode": "delay", "ms": 150},          # config server degrades
+       {"kind": "kill_replica", "step": 6,
+        "role": "leader"},                    # config replica dies FOREVER
        {"kind": "partition", "host": "a", "at_ms": 3000,
         "heal_ms": 5500}                      # netns link flap
      ],
@@ -52,6 +54,15 @@ Event kinds (each validated by `load_scenario`):
 - ``flaky_control`` — the config server degrades for ``requests``
   requests starting roughly at ``step``: ``mode: "delay"`` adds
   ``ms`` per request, ``mode: "refuse"`` returns ``status`` (503).
+- ``kill_replica`` — one member of the REPLICATED control tier
+  (docs/control_plane.md) dies permanently starting roughly at
+  ``step``, matched by ``role`` ("leader" default / "follower") or a
+  pinned ``replica`` index, optionally only on a specific ``path``
+  (e.g. ``"/addworker"`` = mid-resize). Lowered to the
+  ``kill_config_replica`` chaos fault; against a non-replicated
+  single config server the fault never fires (the hook is
+  replica-only), so the scenario only means something when the
+  replay runs the tier.
 - ``partition`` — netns link flap on fake host ``host`` between
   wall offsets ``at_ms`` and ``heal_ms`` (needs the FakeNet fabric;
   the chaos matrix runs these, everything else runs anywhere).
@@ -69,13 +80,14 @@ from dataclasses import dataclass, field
 from typing import Dict, List
 
 _EVENT_KINDS = ("preempt", "resize", "straggler", "flaky_control",
-                "partition")
+                "kill_replica", "partition")
 
 _REQUIRED = {
     "preempt": ("step",),
     "resize": ("step", "size"),
     "straggler": ("step", "rank", "duration_steps", "ms"),
     "flaky_control": ("step", "requests"),
+    "kill_replica": ("step",),
     "partition": ("host", "at_ms", "heal_ms"),
 }
 
@@ -189,6 +201,16 @@ def load_scenario(spec) -> Scenario:
             raise ValueError(
                 f"scenario {name!r}: {kind} event {n} step "
                 f"{ev['step']} outside [0, {steps}]")
+        if kind == "kill_replica":
+            role = str(ev.get("role", "leader"))
+            if role not in ("leader", "follower"):
+                raise ValueError(
+                    f"scenario {name!r}: kill_replica event {n} role "
+                    f"{role!r} (known: leader, follower)")
+            if ev.get("replica") is not None and int(ev["replica"]) < 0:
+                raise ValueError(
+                    f"scenario {name!r}: kill_replica event {n} "
+                    f"replica index must be >= 0")
         if kind == "preempt" and ev.get("host") is not None:
             if ev.get("rank") is not None:
                 raise ValueError(

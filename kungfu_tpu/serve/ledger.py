@@ -39,7 +39,6 @@ DONE), and zero recorded append violations — the serving smoke and
 
 from __future__ import annotations
 
-import itertools
 import threading
 import time
 from collections import deque
@@ -112,7 +111,9 @@ class RequestLedger:
         #: the tier)
         self.max_leases = int(max_leases)
         self._mu = threading.Lock()
-        self._ids = itertools.count(1)
+        # plain int (not itertools.count): replication snapshots must
+        # carry the next id, and a counter cannot be peeked
+        self._next_id = 1  # kf: guarded_by(_mu)
         # kf: guarded_by(_mu)
         self._reqs: Dict[int, Request] = {}
         # kf: guarded_by(_mu) — FIFO admission order
@@ -137,7 +138,8 @@ class RequestLedger:
             if depth >= self.max_queue:
                 raise AdmissionFull(
                     f"admission queue full ({depth}/{self.max_queue})")
-            rid = next(self._ids)
+            rid = self._next_id
+            self._next_id += 1
             self._reqs[rid] = Request(
                 id=rid, prompt=[int(t) for t in prompt],
                 max_new=int(max_new), submitted_t=time.monotonic())
@@ -312,6 +314,69 @@ class RequestLedger:
         with self._mu:
             return [r.to_dict() for r in
                     sorted(self._reqs.values(), key=lambda r: r.id)]
+
+    # -- replication (docs/control_plane.md) --------------------------------
+
+    def snapshot(self) -> Dict:
+        """Full JSON-serializable state for primary-backup replication.
+        Timestamps stay in the leader's time.monotonic domain —
+        CLOCK_MONOTONIC is system-wide on Linux, so a same-host replica
+        tier reads them directly; a takeover across hosts calls
+        `renew_leases` anyway, which re-bases the only timestamps whose
+        absolute value matters (lease expiry)."""
+        with self._mu:
+            return {
+                "next_id": self._next_id,
+                "queue": list(self._queue),
+                "violations": list(self._violations),
+                "recent": list(self._recent),
+                "reqs": [
+                    {
+                        "id": r.id, "prompt": list(r.prompt),
+                        "max_new": r.max_new, "state": r.state,
+                        "tokens": list(r.tokens), "worker": r.worker,
+                        "submitted_t": r.submitted_t,
+                        "done_t": r.done_t, "lease_t": r.lease_t,
+                        "leases": r.leases,
+                    }
+                    for r in self._reqs.values()
+                ],
+            }
+
+    def restore(self, snap: Dict) -> None:
+        """Adopt a leader's snapshot wholesale (idempotent: re-applying
+        the same snapshot is a no-op by construction)."""
+        with self._mu:
+            self._next_id = int(snap["next_id"])
+            self._queue = [int(x) for x in snap["queue"]]
+            self._violations = [str(x) for x in snap["violations"]]
+            self._recent = deque(snap["recent"], maxlen=64)
+            self._reqs = {
+                int(d["id"]): Request(
+                    id=int(d["id"]), prompt=list(d["prompt"]),
+                    max_new=int(d["max_new"]), state=str(d["state"]),
+                    tokens=list(d["tokens"]), worker=str(d["worker"]),
+                    submitted_t=float(d["submitted_t"]),
+                    done_t=float(d["done_t"]),
+                    lease_t=float(d["lease_t"]),
+                    leases=int(d["leases"]))
+                for d in snap["reqs"]
+            }
+
+    def renew_leases(self) -> int:
+        """Re-base every RUNNING lease to now — leader takeover. The
+        election window ate into the leases the dead leader granted;
+        without the re-base a takeover longer than lease_ms would
+        reclaim every in-flight request at once and re-run work whose
+        workers are still healthily decoding. Returns renewals."""
+        now = time.monotonic()
+        n = 0
+        with self._mu:
+            for r in self._reqs.values():
+                if r.state == RUNNING:
+                    r.lease_t = now
+                    n += 1
+        return n
 
     def check_invariants(self) -> List[str]:
         """Empty list == healthy (see module docstring)."""

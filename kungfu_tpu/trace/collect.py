@@ -21,7 +21,6 @@ from __future__ import annotations
 import itertools
 import json
 import threading
-import urllib.request
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -119,17 +118,19 @@ class TraceShipper:
             "nonce": self._rec.nonce,
             **self._rec.context,
             "events": batch,
-        }).encode()
-        req = urllib.request.Request(
-            self.url, data=body, method="POST",
-            headers={"Content-Type": "application/json"})
+        })
+        # post_url with NO_RETRY keeps the trace plane's contract —
+        # single shot, drop on failure, never backoff loops competing
+        # with control-plane traffic — while inheriting the replica
+        # failover inside ONE attempt (KF_CONFIG_SERVERS,
+        # docs/control_plane.md): a dead config leader costs one hop
+        # to a sibling, not a dropped batch
+        from ..peer import post_url
+        from ..retrying import NO_RETRY
+
         try:
-            # deliberately OUTSIDE the retrying.py policy: the trace
-            # plane's contract is drop-on-failure with a short timeout,
-            # never backoff loops competing with control-plane traffic
-            # kflint: disable=retry-discipline
-            with urllib.request.urlopen(req, timeout=self._timeout):
-                pass
+            post_url(self.url, body, timeout=self._timeout,
+                     retry=NO_RETRY)
             self.posted_events += len(batch)
         # drop-on-failure is the contract: the trace plane must never
         # backpressure training, and the batch stays visible in the
@@ -186,6 +187,24 @@ class TraceStore:
                 "total_events": self._total,
                 "dropped": self.dropped,
             }
+
+    def restore(self, snap: Dict) -> None:
+        """Adopt a replication snapshot wholesale (the exact shape
+        `snapshot` emits) — primary-backup push from the config
+        leader, docs/control_plane.md. Idempotent re-apply."""
+        with self._mu:
+            self._sources = {}
+            total = 0
+            for i, src in enumerate(snap.get("sources", [])):
+                meta = dict(src.get("meta", {}))
+                key = str(meta.get("nonce") or
+                          f"{meta.get('role', '?')}-"
+                          f"{meta.get('rank', '?')}-{i}")
+                events = list(src.get("events", []))
+                self._sources[key] = {"meta": meta, "events": events}
+                total += len(events)
+            self._total = total
+            self.dropped = int(snap.get("dropped", 0))
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot())

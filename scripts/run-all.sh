@@ -298,6 +298,52 @@ print(f"SERVE FAST-PATH SMOKE OK: {st['done']} requests, "
       f"{chunks} prefill chunks, peak KV blocks {max(peaks)}")
 EOF
 
+echo "== [4i/7] replicated control plane: kill leader mid-resize under live traffic =="
+# the replicated config tier (docs/control_plane.md): a 3-replica
+# leader-leased tier fronts the SAME 2-worker decode cluster as 4h,
+# and a kill_config_replica chaos fault PERMANENTLY kills the leader
+# on the exact /addworker of the mid-traffic grow. The new leader's
+# takeover must renew the in-flight serve leases and re-push state so
+# EVERY request completes, the membership version advances gap-free
+# on every survivor, and the ledger invariants stay clean — the
+# client side rides KF_CONFIG_SERVERS failover with a retry deadline
+# sized past the election window (the documented client contract).
+timeout 400 python - <<'EOF'
+from kungfu_tpu import chaos
+from kungfu_tpu.elastic.replica import ReplicaTier
+from kungfu_tpu.serve.harness import (RESIZE_MARKERS, default_requests,
+                                      run_serve_cluster)
+tier = ReplicaTier(n=3, lease_ms=500.0)
+try:
+    chaos.load({"faults": [{"type": "kill_config_replica",
+                            "role": "leader", "path": "/addworker"}]})
+    out = run_serve_cluster(
+        default_requests(12, gen_len=48), start_np=2,
+        grow_when_done=5, server=tier,
+        extra_env={**tier.env(), "KF_SERVE_MAX_BATCH": "4",
+                   "KF_SERVE_LEASE_MS": "3000",
+                   "KF_RETRY_ATTEMPTS": "10",
+                   "KF_RETRY_DEADLINE_MS": "30000"},
+        port_range="26000-26999", timeout=360, markers=RESIZE_MARKERS)
+    st = out["stats"]
+    assert st["failed"] == 0 and st["done"] == 12, st
+    dead = [r.index for r in tier.replicas if r.dead]
+    assert len(dead) == 1, dead
+    versions = tier.stage_versions()
+    assert versions == [1, 1], versions
+    viol = tier.serve_ledger.check_invariants()
+    assert viol == [], viol
+    lead = tier.wait_leader()
+    assert set(lead.mttr_marks) >= {"detect", "elected",
+                                    "catchup_done"}, lead.mttr_marks
+finally:
+    tier.stop()
+    chaos.load(None)
+    chaos._reset()
+print(f"CONTROL-PLANE SMOKE OK: leader r{dead[0]} killed mid-resize, "
+      f"12/12 served, stage v{versions[0]} on both survivors")
+EOF
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

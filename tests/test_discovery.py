@@ -121,6 +121,68 @@ def test_http_self_resolve_two_runners():
     assert results["b"] == {"localhost": parse_ipv4("127.0.0.1")}
 
 
+def test_http_self_resolve_serves_after_own_poll():
+    """Regression (the PR 15 tier-1 load flake): a runner whose own
+    polls complete FIRST must keep serving a valid /resolve body to
+    peers that poll it later. The poll loop used to rebind the `body`
+    closure variable its own handler serves — after the first
+    successful fetch the handler tried to write a str and died
+    mid-reply, so under load (which staggers the two runners) the
+    slower side saw truncated answers and the handshake failed."""
+    import time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.request import urlopen
+
+    pa, pb = alloc_ports(2)
+    peer_polled = threading.Event()
+
+    class FakePeer(BaseHTTPRequestHandler):
+        def do_GET(self):
+            payload = b"127.0.0.2"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            peer_polled.set()
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("0.0.0.0", pb), FakePeer)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    result = {}
+
+    def runner():
+        result["out"] = resolve_peers_via_http(
+            parse_ipv4("127.0.0.1"), pa, [("localhost", pb)],
+            timeout_s=30)
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    try:
+        # wait until the runner's own poll has succeeded (the moment
+        # the old code corrupted its served payload), then fetch its
+        # /resolve like a slower peer would
+        assert peer_polled.wait(20), "runner never polled the peer"
+        deadline = time.monotonic() + 10
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                with urlopen(f"http://127.0.0.1:{pa}/resolve",
+                             timeout=2) as r:
+                    got = r.read().decode().strip()
+                break
+            except OSError:
+                time.sleep(0.05)  # runner's server may still be binding
+        assert got == "127.0.0.1", got
+        t.join(30)
+        assert result.get("out") == {"localhost": parse_ipv4("127.0.0.2")}
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_http_self_resolve_timeout():
     port, silent = alloc_ports(2)
     with pytest.raises(TimeoutError, match="no answer"):

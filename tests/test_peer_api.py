@@ -70,8 +70,9 @@ class TestSingleProcessPeer:
         assert p.consensus(b"anything")
 
 
-def make_peer_cluster(n, base_port):
+def make_peer_cluster(n, base_port, ports=None):
     peers = PeerList.parse(
+        ",".join(f"127.0.0.1:{p}" for p in ports) if ports else
         ",".join(f"127.0.0.1:{base_port + i}" for i in range(n)))
     cfgs = [
         kfenv.Config(self_id=peers[i], init_peers=peers, version=0,
@@ -103,7 +104,12 @@ def run_on_all(peers, fn):
 
 class TestMultiPeer:
     def test_start_barrier_allreduce(self):
-        peers = make_peer_cluster(3, 22000)
+        # ports from the suite-wide counter, not a hardcoded base: a
+        # fixed 22000 sat inside alloc_ports' 21000+ range, and a long
+        # tier-1 run can walk the shared counter across it
+        from test_control_plane import alloc_ports
+
+        peers = make_peer_cluster(3, 0, ports=alloc_ports(3))
         try:
             run_on_all(peers, lambda p, i: p.start())
             def work(p, rank):
