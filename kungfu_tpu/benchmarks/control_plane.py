@@ -1,19 +1,26 @@
 """Control-plane benchmark: replication cost + leader-takeover MTTR.
 
 The replicated config tier (elastic/replica.py, docs/control_plane.md)
-buys survival of PERMANENT leader loss with synchronous full-snapshot
-replication. This module prices both sides of that trade and publishes
-the BASELINE `control_plane_replicated` rows:
+buys survival of PERMANENT leader loss with replicate-before-ack
+delta-log replication. This module prices both sides of that trade and
+publishes the BASELINE `control_plane_replicated` +
+`control_plane_router` rows:
 
 - **Replication cost vs replica count {1, 2, 3}**: membership-op
   latency (p50/p99 of `/addworker`//`/removeworker` round trips at the
-  leader — each one is a mutation, so each one carries a synchronous
-  push to every follower before the 200) and serve-ledger admissions/s
-  over a fixed submit burst. n=1 is the PR-2 single-server behavior
-  (no push) — the delta against n=2/3 IS the price of durability.
-  Full-snapshot replication means per-op cost also grows with ledger
-  size; the burst is kept short so the rows price the protocol, not
-  the snapshot's O(requests) encoding.
+  leader — each one is a mutation, replicated before the 200) and
+  serve-ledger admissions/s over a CONCURRENT submit burst (8 client
+  threads — group commit amortizes the push across ops sharing a
+  commit window, which only overlapping clients exercise). n=1 is the
+  PR-2 single-server behavior (no push) — the delta against n=2/3 IS
+  the price of durability. The n=3 row is re-run with
+  ``KF_CP_COMMIT_MS=0`` (one delta push per op): that ablation prices
+  group commit itself.
+- **Router tier {1, 2}**: the same burst through the stateless
+  admission routers (serve/router.py) that coalesce submits into
+  batched ledger writes, plus a chaos row that kills router 0
+  mid-burst and gates on ZERO dropped requests (every acked id must
+  be in the ledger).
 - **Takeover MTTR, decomposed**: kill the leader permanently
   (`die()` for the mid-traffic shape; the `kill_config_replica` chaos
   fault riding a live `/addworker` for the mid-resize shape) while a
@@ -64,23 +71,54 @@ def _percentile(values: List[float], q: float) -> float:
     return percentile(sorted(values), q)
 
 
+#: concurrent submitters for the admission burst — group commit only
+#: amortizes when writes OVERLAP (a serial burst has one op per
+#: window), and overlapping clients are what a serving front door
+#: actually produces
+_ADMIT_THREADS = 8
+
+
+def _sync(barrier: threading.Barrier,
+          errs: List[BaseException]) -> None:
+    """Barrier wait that surfaces a pump thread's real failure: a pump
+    dying before its wait() breaks the barrier for everyone, and the
+    bare BrokenBarrierError would mask the actual exception."""
+    try:
+        barrier.wait(10)
+    except threading.BrokenBarrierError:
+        if errs:
+            raise errs[0] from None
+        raise
+
+
 def measure_replication_cost(n: int, lease_ms: float, ops: int,
-                             submits: int) -> Dict[str, float]:
-    """One tier of `n` replicas: membership-op latency + admissions/s,
-    every op served by the leader (so n>1 rows carry the synchronous
-    push to n-1 followers inside the measured round trip)."""
+                             submits: int,
+                             commit_ms: Optional[float] = None
+                             ) -> Dict[str, float]:
+    """One tier of `n` replicas: membership-op latency (serial, so
+    each round trip prices one full replicate-before-ack cycle) +
+    admissions/s over a CONCURRENT submit burst (`_ADMIT_THREADS`
+    clients — the group-commit amortization shows up only when ops
+    share a commit window). `commit_ms` overrides KF_CP_COMMIT_MS for
+    the tier (0 = per-op flush, i.e. group commit OFF)."""
+    import os
+
     from ..elastic.replica import ReplicaTier
     from ..peer import post_url, put_url
     from ..retrying import NO_RETRY
     from ..serve import frontend
 
-    tier = ReplicaTier(n=n, lease_ms=lease_ms)
+    saved = os.environ.get("KF_CP_COMMIT_MS")
+    if commit_ms is not None:
+        os.environ["KF_CP_COMMIT_MS"] = str(commit_ms)
+    tier = None
     try:
+        tier = ReplicaTier(n=n, lease_ms=lease_ms)
         lead = tier.wait_leader()
         put_url(lead.base + "/put", _mk_stage().to_json(),
                 retry=NO_RETRY)
         for r in tier.replicas:
-            r.serve_ledger.max_queue = submits + 16
+            r.serve_ledger.max_queue = submits + 64
         # alternate add/remove starting with add: the worker count
         # stays in {1, 2}, so no op can be rejected for emptying it
         lat_ms: List[float] = []
@@ -89,18 +127,180 @@ def measure_replication_cost(n: int, lease_ms: float, ops: int,
             t0 = time.perf_counter()
             post_url(lead.base + route, "{}", retry=NO_RETRY)
             lat_ms.append((time.perf_counter() - t0) * 1e3)
+        per = submits // _ADMIT_THREADS
+        errs: List[BaseException] = []
+        warm = threading.Barrier(_ADMIT_THREADS + 1)
+        bar = threading.Barrier(_ADMIT_THREADS + 1)
+
+        def pump(k: int) -> None:
+            try:
+                # untimed warmup: opens each thread's pooled
+                # connection and absorbs first-request costs, so the
+                # timed region prices the protocol (same rule as every
+                # other warm-measured BASELINE row)
+                warm.wait(10)
+                for i in range(2):
+                    frontend.submit(lead.get_url, [9, k, i], 8,
+                                    retry=NO_RETRY)
+                bar.wait(10)
+                for i in range(per):
+                    frontend.submit(lead.get_url, [1, 2, k, i % 50],
+                                    8, retry=NO_RETRY)
+            # stashed for the measuring thread, re-raised below — no
+            # shape is swallowed
+            # kflint: disable=retry-discipline
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        workers = [threading.Thread(target=pump, args=(k,),
+                                    daemon=True, name=f"kf-cp-admit{k}")
+                   for k in range(_ADMIT_THREADS)]
+        for t in workers:
+            t.start()
+        _sync(warm, errs)
+        _sync(bar, errs)
         t0 = time.perf_counter()
-        for i in range(submits):
-            frontend.submit(lead.get_url, [1, 2, 3, i % 50], 8,
-                            retry=NO_RETRY)
+        for t in workers:
+            t.join()
         admit_s = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        batches = lead.status()["delta_batches"]
     finally:
-        tier.stop()
+        if tier is not None:
+            tier.stop()
+        if commit_ms is not None:
+            if saved is None:
+                os.environ.pop("KF_CP_COMMIT_MS", None)
+            else:
+                os.environ["KF_CP_COMMIT_MS"] = saved
+    done = per * _ADMIT_THREADS
     return {
         "membership_p50_ms": round(_percentile(lat_ms, 50.0), 2),
         "membership_p99_ms": round(_percentile(lat_ms, 99.0), 2),
-        "admissions_per_s": round(submits / admit_s, 1),
+        "admissions_per_s": round(done / admit_s, 1),
+        "admission_threads": _ADMIT_THREADS,
+        "delta_batches": batches,
     }
+
+
+def measure_router(n_routers: int, lease_ms: float, submits: int,
+                   kill_mid_burst: bool = False) -> Dict[str, float]:
+    """Admission throughput THROUGH the stateless router tier: a
+    3-replica config tier behind `n_routers` routers, the same
+    concurrent burst aimed round-robin at the routers (clients list
+    them in KF_SERVE_ROUTERS, so peer.py fails over across them).
+    With `kill_mid_burst`, a `kill_router` chaos fault takes router 0
+    down mid-traffic — the row then gates on ZERO dropped requests:
+    every id acked to any client must exist in the ledger."""
+    import importlib
+    import os
+
+    from .. import chaos as chaos_mod
+    from ..elastic.replica import ReplicaTier
+    from ..peer import put_url
+    from ..retrying import NO_RETRY, RetryPolicy
+    from ..serve import frontend
+    from ..serve.router import Router
+
+    peer_mod = importlib.import_module("kungfu_tpu.peer")
+    saved = os.environ.get("KF_SERVE_ROUTERS")
+    tier = ReplicaTier(n=3, lease_ms=lease_ms)
+    routers: List[Router] = []
+    try:
+        lead = tier.wait_leader()
+        put_url(lead.base + "/put", _mk_stage().to_json(),
+                retry=NO_RETRY)
+        for r in tier.replicas:
+            r.serve_ledger.max_queue = submits + 64
+        routers = [Router(tier.bases, index=i).start()
+                   for i in range(n_routers)]
+        os.environ["KF_SERVE_ROUTERS"] = ",".join(
+            r.base for r in routers)
+        retry = NO_RETRY
+        if kill_mid_burst:
+            chaos_mod.load({"faults": [
+                {"type": "kill_router", "router": 0,
+                 "after_requests": max(10, submits // 8)}]})
+            # the failover path needs retries: the killed router's
+            # in-flight submits die un-acked and must resubmit
+            retry = RetryPolicy(attempts=8, base_ms=50.0,
+                                max_ms=400.0, deadline_s=20.0,
+                                name="bench-router-failover")
+        per = submits // _ADMIT_THREADS
+        ids: List[List[int]] = [[] for _ in range(_ADMIT_THREADS)]
+        errs: List[BaseException] = []
+        warm = threading.Barrier(_ADMIT_THREADS + 1)
+        bar = threading.Barrier(_ADMIT_THREADS + 1)
+
+        def pump(k: int) -> None:
+            aim = routers[k % len(routers)].base
+            try:
+                warm.wait(10)  # untimed warmup (see replication_cost)
+                for i in range(2):
+                    ids[k].append(frontend.submit(
+                        aim, [9, k, i], 8, retry=retry))
+                bar.wait(10)
+                for i in range(per):
+                    ids[k].append(frontend.submit(
+                        aim, [2, k, i % 50], 8, retry=retry))
+            # stashed + re-raised below
+            # kflint: disable=retry-discipline
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        workers = [threading.Thread(target=pump, args=(k,),
+                                    daemon=True,
+                                    name=f"kf-router-admit{k}")
+                   for k in range(_ADMIT_THREADS)]
+        for t in workers:
+            t.start()
+        _sync(warm, errs)
+        _sync(bar, errs)
+        t0 = time.perf_counter()
+        for t in workers:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        acked = [i for sub in ids for i in sub]
+        ledger_ids = {r["id"] for r in lead.serve_ledger.results()}
+        dropped = sorted(set(acked) - ledger_ids)
+        if dropped:
+            raise RuntimeError(
+                f"{len(dropped)} acked submits missing from the "
+                f"ledger: {dropped[:5]}...")
+        if len(set(acked)) != len(acked):
+            raise RuntimeError("duplicate ids acked across routers")
+        bad = lead.serve_ledger.check_invariants()
+        if bad:
+            raise RuntimeError(f"ledger invariants violated: {bad}")
+        timed = len(acked) - 2 * _ADMIT_THREADS  # minus warmup
+        out = {
+            "routers": n_routers,
+            "admissions_per_s": round(timed / wall, 1),
+            "acked": len(acked),
+            "dropped": 0,
+            "flushed_batches": sum(r.flushed_batches
+                                   for r in routers),
+        }
+        if kill_mid_burst:
+            out["router_killed"] = bool(routers[0].dead)
+            if not routers[0].dead:
+                raise RuntimeError("kill_router never fired")
+        return out
+    finally:
+        for r in routers:
+            r.stop()
+        tier.stop()
+        if kill_mid_burst:
+            chaos_mod.load(None)
+            chaos_mod._reset()
+        if saved is None:
+            os.environ.pop("KF_SERVE_ROUTERS", None)
+        else:
+            os.environ["KF_SERVE_ROUTERS"] = saved
+        peer_mod.reset_transport()
 
 
 def _mk_stage(version: int = 0):
@@ -324,8 +524,10 @@ def main(argv=None) -> int:
                     help="takeover kills per shape")
     ap.add_argument("--ops", type=int, default=40,
                     help="membership ops per replica-count row")
-    ap.add_argument("--submits", type=int, default=120,
-                    help="admission burst per replica-count row")
+    ap.add_argument("--submits", type=int, default=320,
+                    help="admission burst per replica-count row "
+                         "(split across 8 concurrent submitters; "
+                         "router rows drive 2x this)")
     ap.add_argument("--lease-ms", type=float, default=300.0,
                     help="tier lease (the detect phase's knob)")
     ap.add_argument("--json", action="store_true",
@@ -344,6 +546,37 @@ def main(argv=None) -> int:
               f"{cost[str(n)]['membership_p99_ms']} ms, "
               f"{cost[str(n)]['admissions_per_s']} admissions/s",
               flush=True)
+    # the ablation that prices the tentpole: the SAME n=3 burst with
+    # the commit window forced to 0 (one delta push per op — r17's
+    # per-mutation snapshot push, modulo payload size)
+    no_batch = measure_replication_cost(
+        3, args.lease_ms, args.ops, args.submits, commit_ms=0.0)
+    group_commit_speedup = (
+        round(cost["3"]["admissions_per_s"]
+              / no_batch["admissions_per_s"], 2)
+        if no_batch["admissions_per_s"] else None)
+    print(f"replicas=3 commit_ms=0: "
+          f"{no_batch['admissions_per_s']} admissions/s "
+          f"(group-commit speedup {group_commit_speedup}x)",
+          flush=True)
+
+    router: Dict[str, Dict[str, float]] = {}
+    for nr in (1, 2):
+        router[str(nr)] = measure_router(nr, args.lease_ms,
+                                         args.submits * 2)
+        print(f"routers={nr}: "
+              f"{router[str(nr)]['admissions_per_s']} admissions/s "
+              f"({router[str(nr)]['flushed_batches']} coalesced "
+              "flushes)", flush=True)
+    router_chaos = measure_router(2, args.lease_ms, args.submits * 2,
+                                  kill_mid_burst=True)
+    print(f"routers=2 + kill_router mid-burst: "
+          f"{router_chaos['admissions_per_s']} admissions/s, "
+          f"dropped={router_chaos['dropped']}", flush=True)
+    router_scaling = (
+        round(router["2"]["admissions_per_s"]
+              / router["1"]["admissions_per_s"], 2)
+        if router["1"]["admissions_per_s"] else None)
 
     takeover: Dict[str, Dict[str, float]] = {}
     source = "cp_marks"
@@ -367,17 +600,26 @@ def main(argv=None) -> int:
         "runs": args.runs,
         "source": source,
         "replication_cost": cost,
-        "takeover": takeover,
+        "no_batch_n3": no_batch,
+        "group_commit_speedup": group_commit_speedup,
+        "router": router,
+        "router_chaos": router_chaos,
+        "router_scaling": router_scaling,
         "note": (
             "in-process 3-replica tier on loopback, 1-core container "
             "— absolute latencies include core contention and the "
             "admission burst shares the core with the replicas; the "
             "portable results are the STRUCTURE (detect ~= the "
             "staggered election timeout dominates MTTR; its knob is "
-            "KF_CONFIG_LEASE_MS) and the n=1 vs n>1 deltas (the "
-            "synchronous-push price of surviving permanent leader "
-            "loss). Full-snapshot replication: membership/admission "
-            "cost also grows with ledger size (docs/control_plane.md)"
+            "KF_CONFIG_LEASE_MS), the n=1 vs n>1 deltas (the "
+            "replicate-before-ack price of surviving permanent "
+            "leader loss), and the group-commit ablation (the SAME "
+            "n=3 burst with KF_CP_COMMIT_MS=0 prices one delta push "
+            "per op). Admission bursts are 8-way concurrent — group "
+            "commit only amortizes overlapping writes. Router rows "
+            "drive the burst through the stateless front door "
+            "(serve/router.py); the chaos row kills router 0 "
+            "mid-burst and gates on zero dropped requests"
         ),
     }
     if args.json:
@@ -392,6 +634,31 @@ def main(argv=None) -> int:
     if args.publish:
         from .publish import publish_result
 
+        publish_result(
+            "control_plane_router",
+            {"benchmark": "control_plane_router",
+             "lease_ms": args.lease_ms,
+             "router": router, "router_chaos": router_chaos,
+             "router_scaling": router_scaling,
+             "note": result["note"]},
+            parsed={
+                "metric": "cp_router_admissions_per_s",
+                "value": router["2"]["admissions_per_s"],
+                "unit": ("admissions/s through 2 stateless routers "
+                         "coalescing into a 3-replica group-commit "
+                         "tier, 8-way concurrent burst"),
+                "details": {
+                    "routers_1": router["1"]["admissions_per_s"],
+                    "routers_2": router["2"]["admissions_per_s"],
+                    "router_scaling": router_scaling,
+                    "chaos_kill_admissions_per_s":
+                        router_chaos["admissions_per_s"],
+                    "chaos_kill_dropped": router_chaos["dropped"],
+                    "caveat": "1-core loopback; see BASELINE.md",
+                },
+            },
+            cmd=("python -m kungfu_tpu.benchmarks.control_plane "
+                 "--publish"))
         publish_result(
             "control_plane_replicated", result,
             parsed={
@@ -413,6 +680,9 @@ def main(argv=None) -> int:
                         cost["1"]["admissions_per_s"],
                         cost["2"]["admissions_per_s"],
                         cost["3"]["admissions_per_s"]],
+                    "admissions_per_s_n3_no_batch":
+                        no_batch["admissions_per_s"],
+                    "group_commit_speedup": group_commit_speedup,
                     "source": source,
                     "caveat": "1-core loopback; see BASELINE.md",
                 },

@@ -289,11 +289,29 @@ def main(argv=None) -> int:
         from kungfu_tpu.benchmarks.publish import publish_result
 
         prefix = res["prefix_cell"]
+        # the per-np timing decomposition goes INTO the published row:
+        # "where did the wall time go" (control_share is the headline —
+        # the router/group-commit work is judged by driving it down)
+        breakdown = {
+            f"np{r['np']}": {
+                "control_share": r["timing"]["control_share"],
+                "decode_s": round(r["timing"]["decode_ms"] / 1e3, 2),
+                "prefill_s": round(r["timing"]["prefill_ms"] / 1e3, 2),
+                "control_s": round(r["timing"]["control_ms"] / 1e3, 2),
+                "peak_blocks": r["timing"]["peak_blocks"],
+                "tokens_per_sec": r["tokens_per_sec"],
+            } for r in res["cells"]
+        }
+        # the breakdown also rides the BASELINE row: BENCH_rNN.json is
+        # one-headline-per-round and a later publisher overwrites it,
+        # but the BASELINE row is per-metric and persists
+        result["timing_breakdown"] = breakdown
         publish_result(
             "serve_elastic_latency", result,
             parsed={"metric": "serve_p99_through_resize_ms",
                     "value": res["resize_cell"]["p99_ms"],
                     "unit": "ms",
+                    "timing_breakdown": breakdown,
                     "tokens_per_sec_np2":
                         next((r["tokens_per_sec"] for r in
                               res["cells"] if r["np"] == 2), None),

@@ -31,6 +31,7 @@ Schedule format (``KF_CHAOS`` inline JSON, or ``KF_CHAOS_FILE`` path)::
         {"type": "die_config_server", "after_requests": 10},
         {"type": "kill_config_replica", "role": "leader",
          "path": "/addworker"},
+        {"type": "kill_router", "router": 0, "after_requests": 20},
         {"type": "drop_control", "name": "update", "count": 1},
         {"type": "delay_control", "name": "update", "ms": 100, "count": 2},
         {"type": "spawn_delay", "rank": 2, "ms": 500, "count": 1},
@@ -90,6 +91,7 @@ _KNOWN_TYPES = {
     "delay_http",
     "die_config_server",
     "kill_config_replica",
+    "kill_router",
     "drop_control",
     "delay_control",
     "spawn_delay",
@@ -358,6 +360,30 @@ def on_replica_request(path: str, replica: int, role: str
               role=role, request=idx)
         return {"kill": True}
     return _http_action(sched, idx, path)
+
+
+def on_router_request(path: str, router: int,
+                      request_idx: int) -> Optional[Dict]:
+    """serve/router.py handler hook: ``kill_router`` — PERMANENT death
+    of one admission router (``{"kill": True}``), the front-door
+    analogue of ``kill_config_replica``. Matched on the router index
+    and an ``after_requests`` threshold against the ROUTER'S OWN
+    request counter (passed in as ``request_idx``): router traffic is
+    serve-plane and workload-dependent, so it must not advance the
+    shared control-plane request index that ``after_requests``
+    schedules for config servers are pinned to."""
+    sched = active()
+    if sched is None:
+        return None
+    f = sched.take(
+        "kill_router", path=path, router=router,
+        _when=lambda f: request_idx >= int(
+            f.spec.get("after_requests", 0)))
+    if f is not None:
+        _fire("kill_router", path=path, router=router,
+              request=request_idx)
+        return {"kill": True}
+    return None
 
 
 def _http_action(sched: ChaosSchedule, idx: int,

@@ -42,6 +42,8 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import socket
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -50,6 +52,57 @@ from typing import Optional
 from .. import chaos
 from ..peer import Stage
 from ..plan import Cluster
+
+
+class _KeepAliveHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that tracks open client connections.
+
+    With HTTP/1.1 keep-alive, handler threads outlive serve_forever():
+    shutdown() only stops the accept loop, so without this a "stopped"
+    server would keep answering requests on already-open pooled client
+    connections — breaking every crash/restart test and chaos fault.
+    stop() closes the tracked sockets; readers see a clean EOF and the
+    handler threads exit."""
+
+    daemon_threads = True
+    # default listen backlog (5) RSTs simultaneous connect bursts from
+    # pooled clients that all open their first connection at once
+    request_queue_size = 128
+
+    def __init__(self, *args, **kwargs):
+        self._kf_mu = threading.Lock()
+        self._kf_conns: set = set()  # kf: guarded_by(_kf_mu)
+        super().__init__(*args, **kwargs)
+
+    def kf_track(self, sock) -> None:
+        with self._kf_mu:
+            self._kf_conns.add(sock)
+
+    def kf_untrack(self, sock) -> None:
+        with self._kf_mu:
+            self._kf_conns.discard(sock)
+
+    def kf_close_connections(self) -> None:
+        with self._kf_mu:
+            conns = list(self._kf_conns)
+            self._kf_conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def handle_error(self, request, client_address):
+        # a forced close (stop() above, chaos die) surfaces in the
+        # handler thread as a connection error — expected, not noise
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, OSError)):
+            return
+        super().handle_error(request, client_address)
 
 
 class ConfigServer:
@@ -79,6 +132,14 @@ class ConfigServer:
                                minimum=100.0))
         self._stage: Optional[Stage] = None  # kf: guarded_by(_lock)
         self._initial: Optional[Stage] = None  # kf: guarded_by(_lock)
+        # serializes {apply mutation + append to the replication op
+        # log} so log order == application order — follower replay is
+        # only deterministic if both agree (e.g. concurrent submits
+        # must assign request ids in the logged order). Also taken by
+        # full-snapshot builders so a snapshot stamped seq N contains
+        # exactly the ops logged through N (delta replay is NOT
+        # idempotent, unlike the old wholesale restores).
+        self._mut_mu = threading.RLock()
         # kf: guarded_by(_lock)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -153,9 +214,17 @@ class ConfigServer:
         marking)."""
         return {}
 
-    def _on_mutation(self, kind: str) -> None:
-        """Called after every successful state mutation ("stage",
-        "serve", "trace") — the replication push point."""
+    def _on_mutation(self, kind: str, op: Optional[dict] = None):
+        """Called with every successful state mutation ("stage",
+        "serve", "trace") while the handler holds ``_mut_mu`` — the
+        replication point. ``op`` is the replayable wire form
+        {method, path, body}. Returns None (ack immediately — the
+        tier-of-one case) or a wait-callable the handler must invoke
+        OUTSIDE ``_mut_mu``: it blocks until the mutation's delta
+        batch replicated and returns False if replication failed
+        (leader deposed mid-commit), in which case the handler
+        answers 503 and the client retries against the new leader."""
+        return None
 
     def _chaos_hook(self, path: str):
         return chaos.on_http_request(path)
@@ -199,8 +268,34 @@ class ConfigServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 => keep-alive by default: one connection (and
+            # one ThreadingHTTPServer handler thread) serves a client's
+            # whole request stream instead of connect+thread per call.
+            # Safe because _reply always sends Content-Length. The
+            # read timeout reaps idle connections (http.server turns
+            # socket.timeout into a clean connection close).
+            protocol_version = "HTTP/1.1"
+            timeout = 30.0
+            # keep-alive responses are small write-write-read
+            # exchanges; Nagle + delayed ACK would stall each ~40 ms
+            disable_nagle_algorithm = True
+
             def log_message(self, *args):  # quiet
                 pass
+
+            def setup(self):
+                super().setup()
+                track = getattr(self.server, "kf_track", None)
+                if track is not None:
+                    track(self.connection)
+
+            def finish(self):
+                try:
+                    super().finish()
+                finally:
+                    untrack = getattr(self.server, "kf_untrack", None)
+                    if untrack is not None:
+                        untrack(self.connection)
 
             def _reply(self, code: int, body: str = "",
                        headers: Optional[dict] = None):
@@ -246,6 +341,7 @@ class ConfigServer:
                         self.connection.close()
                     except OSError:
                         pass
+                    self.close_connection = True
                     return True
                 if "refuse" in action:
                     self._reply(int(action["refuse"]),
@@ -261,20 +357,34 @@ class ConfigServer:
                     return False
                 from kungfu_tpu.serve.frontend import handle_serve
 
-                out = handle_serve(server.serve_ledger, method,
-                                   self.path, body)
+                if method == "GET":
+                    out = handle_serve(server.serve_ledger, method,
+                                       self.path, body)
+                    if out is None:
+                        return False
+                    self._reply(out[0], out[1], server._read_headers())
+                    return True
+                # mutation: apply + log atomically under _mut_mu so
+                # the delta log records ops in application order, then
+                # replicate BEFORE acking: a 200 must mean the
+                # mutation survives the leader's death, else a submit
+                # acked an instant before a kill is lost
+                with server._mut_mu:
+                    out = handle_serve(server.serve_ledger, method,
+                                       self.path, body)
+                    wait = None
+                    if out is not None and out[0] == 200:
+                        wait = server._on_mutation("serve", {
+                            "method": method, "path": self.path,
+                            "body": body})
                 if out is None:
                     return False
                 code, payload = out
-                if method == "GET":
-                    self._reply(code, payload, server._read_headers())
-                else:
-                    # replicate BEFORE acking: a 200 must mean the
-                    # mutation survives the leader's death, else a
-                    # submit acked an instant before a kill is lost
-                    if code == 200:
-                        server._on_mutation("serve")
-                    self._reply(code, payload)
+                if wait is not None and not wait():
+                    self._reply(503, '{"error": "write not replicated'
+                                     ' (leader changed mid-commit)"}')
+                    return True
+                self._reply(code, payload)
                 return True
 
             def do_GET(self):
@@ -315,14 +425,23 @@ class ConfigServer:
                 if self._serve("POST", body):
                     return
                 if self.path.startswith("/trace"):
-                    try:
-                        taken = server.trace_store.add_batch(
-                            json.loads(body))
-                    except (ValueError, KeyError, TypeError) as e:
-                        self._reply(400,
-                                    json.dumps({"error": str(e)}))
+                    with server._mut_mu:
+                        try:
+                            taken = server.trace_store.add_batch(
+                                json.loads(body))
+                        except (ValueError, KeyError, TypeError) as e:
+                            self._reply(400,
+                                        json.dumps({"error": str(e)}))
+                            return
+                        # replicate, THEN ack
+                        wait = server._on_mutation("trace", {
+                            "method": "POST", "path": self.path,
+                            "body": body})
+                    if wait is not None and not wait():
+                        self._reply(503,
+                                    '{"error": "write not replicated'
+                                    ' (leader changed mid-commit)"}')
                         return
-                    server._on_mutation("trace")  # replicate, THEN ack
                     self._reply(200, json.dumps({"accepted": taken}))
                     return
                 if self.path.startswith("/stop"):
@@ -333,26 +452,36 @@ class ConfigServer:
                 if self._chaos():
                     return
                 err = None
-                if self.path.startswith("/put"):
-                    try:
-                        err = server._put(Stage.from_json(body))
-                    except (ValueError, KeyError) as e:
-                        err = f"bad stage json: {e}"
-                elif self.path.startswith("/addworker"):
-                    err = server._resize(+1)
-                elif self.path.startswith("/removeworker"):
-                    err = server._resize(-1)
-                elif self.path.startswith("/clear"):
-                    err = server._clear()
-                elif self.path.startswith("/reset"):
-                    err = server._reset()
-                else:
-                    err = "unknown path"
+                with server._mut_mu:
+                    if self.path.startswith("/put"):
+                        try:
+                            err = server._put(Stage.from_json(body))
+                        except (ValueError, KeyError) as e:
+                            err = f"bad stage json: {e}"
+                    elif self.path.startswith("/addworker"):
+                        err = server._resize(+1)
+                    elif self.path.startswith("/removeworker"):
+                        err = server._resize(-1)
+                    elif self.path.startswith("/clear"):
+                        err = server._clear()
+                    elif self.path.startswith("/reset"):
+                        err = server._reset()
+                    else:
+                        err = "unknown path"
+                    wait = None
+                    if not err:
+                        # replicate, THEN ack
+                        wait = server._on_mutation("stage", {
+                            "method": self.command, "path": self.path,
+                            "body": body})
+                    stage_body = server.stage_json() or "{}"
                 if err:
                     self._reply(400, json.dumps({"error": err}))
+                elif wait is not None and not wait():
+                    self._reply(503, '{"error": "write not replicated'
+                                     ' (leader changed mid-commit)"}')
                 else:
-                    server._on_mutation("stage")  # replicate, THEN ack
-                    self._reply(200, server.stage_json() or "{}")
+                    self._reply(200, stage_body)
 
             do_PUT = _do_update
             do_POST = _do_update
@@ -360,8 +489,8 @@ class ConfigServer:
         return Handler
 
     def start(self) -> "ConfigServer":
-        httpd = ThreadingHTTPServer((self.host, self.port),
-                                    self._handler())
+        httpd = _KeepAliveHTTPServer((self.host, self.port),
+                                     self._handler())
         with self._lock:
             # under the same lock stop() swaps through — a scheduled
             # _chaos_die stop thread racing a restart() must see either
@@ -381,6 +510,10 @@ class ConfigServer:
         if httpd is None:
             return
         httpd.shutdown()
+        # keep-alive handler threads outlive serve_forever: force their
+        # sockets closed so a "stopped" server can't keep answering
+        # pooled client connections
+        httpd.kf_close_connections()
         httpd.server_close()
 
     def _chaos_die(self):

@@ -13,13 +13,21 @@ machine as a 2–3 replica tier that survives permanent loss
   iff the candidate's term beats both its current term and anything it
   already voted for. Majority of *responding* replicas wins — see the
   honesty note below.
-- **Synchronous primary-backup replication.** Every successful
-  mutation (stage write, serve-ledger verb, trace batch) pushes the
-  FULL state snapshot (`ConfigServer.state_snapshot`) to every
-  follower, fenced by ``(term, seq)``. There is no operation log to
-  replay: the stage is version-must-grow, and the ledger/trace
-  restores are wholesale — re-applying any snapshot is idempotent,
-  and the seq rule below makes the newest one win.
+- **Delta-log group-commit replication.** Every successful mutation
+  (stage write, serve-ledger verb, trace batch) is appended to a
+  ``(term, seq)``-fenced operation log while it is applied (the
+  handler holds ``_mut_mu`` across both, so log order == application
+  order). A committer thread accumulates ops for up to
+  ``KF_CP_COMMIT_MS`` (or ``_MAX_DELTA_BATCH``) and pushes ONE delta
+  batch to every follower; the handler blocks on its op's ack before
+  answering 200 — replicate-before-ack is preserved, the push is
+  amortized. Followers replay deltas strictly in seq order; any gap,
+  term change or restart falls back to the full-snapshot push
+  (``behind`` stays the repair path, now the exception). Because op
+  replay is NOT idempotent (a replayed submit would mint a second
+  request id), every full snapshot is stamped under ``_mut_mu`` so
+  "state at seq N == replay of ops 1..N" holds exactly and followers
+  may drop any delta op at or below a snapshot's stamp.
 - **Write redirects, stale reads.** A follower answers any write with
   ``307 Location: <leader>`` (peer.py follows it manually, preserving
   method+body); during an election it answers 503, which the
@@ -34,12 +42,17 @@ machine as a 2–3 replica tier that survives permanent loss
   detect → elected → catchup_done decomposition the control-plane
   benchmark measures.
 
-**Seq convergence without a log**: seq is assigned under the lock and
-the snapshot is built *after* assignment, so a push carrying a higher
-seq also snapshots later — whatever mutation triggered a lower-seq
-push is contained in the highest-seq push a follower ever applies.
-Followers apply only strictly-newer (term, seq); a laggard reports
-``behind`` on heartbeat and receives a fresh full push.
+**Seq-domain tracking**: each replica records ``seq_term`` — the term
+whose leader assigned its current seq. A delta batch only replays when
+its term matches the follower's ``seq_term`` and its first fresh op is
+exactly ``seq+1``; otherwise the follower answers ``gap`` and the
+leader repairs with a full snapshot. A heartbeat from a newer term
+therefore always reads as ``behind`` until that term's snapshot
+arrives (adopting a term via heartbeat must not let a stale-seq
+follower masquerade as caught up). Wall-clock ledger fields (lease
+deadlines) may drift by the replay delay between replicas; takeover
+re-bases them (`renew_leases`) and a periodic anti-entropy full push
+(every ``_ANTI_ENTROPY_EVERY`` batches) bounds any residual drift.
 
 **What this is NOT (Raft honesty, expanded in docs/control_plane.md
 and PAPERS.md):** election counts a majority of replicas that
@@ -75,6 +88,16 @@ from .config_server import ConfigServer
 #: replicated state. /stop and /replica/* are replica-local by design.
 _WRITE_PREFIXES = ("/put", "/addworker", "/removeworker", "/clear",
                    "/reset", "/serve", "/trace")
+
+#: group-commit batch cap: a full window's worth of ops ships as one
+#: delta push even under heavy admission bursts
+_MAX_DELTA_BATCH = 64
+
+#: anti-entropy cadence: one full-snapshot push every N delta batches.
+#: Delta replay of clock-dependent ledger verbs (lease reclaim
+#: boundaries) can drift between replicas by the replay delay; this
+#: bounds how long any such drift can live.
+_ANTI_ENTROPY_EVERY = 256
 
 
 class _RPCReject(Exception):
@@ -132,6 +155,7 @@ class ReplicaConfigServer(ConfigServer):
         self.index = int(index)
         self.lease_ms = float(lease_ms) if lease_ms is not None else \
             env_float("KF_CONFIG_LEASE_MS", 2000.0, minimum=100.0)
+        self.commit_ms = env_float("KF_CP_COMMIT_MS", 2.0, minimum=0.0)
         self._rlock = threading.Lock()
         self.term = 0           # kf: guarded_by(_rlock)
         self.voted_term = 0     # kf: guarded_by(_rlock)
@@ -139,6 +163,9 @@ class ReplicaConfigServer(ConfigServer):
         self.role = "follower"  # kf: guarded_by(_rlock)
         self.leader_base = ""   # kf: guarded_by(_rlock) — best known
         self.seq = 0            # kf: guarded_by(_rlock) — replication seq
+        # the term whose leader assigned our seq (module docstring:
+        # seq-domain tracking)
+        self.seq_term = 0       # kf: guarded_by(_rlock)
         self._hb_t = time.monotonic()  # kf: guarded_by(_rlock)
         #: index-aligned replica bases (self included); set by wire()
         self.peers: List[str] = []
@@ -154,6 +181,11 @@ class ReplicaConfigServer(ConfigServer):
         self._stop_monitor = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._unreachable: set = set()
+        # pending delta-log entries awaiting the group-commit flush
+        self._log_cv = threading.Condition()
+        self._log: List[Dict] = []  # kf: guarded_by(_log_cv)
+        self._committer: Optional[threading.Thread] = None
+        self.delta_batches = 0  # committed batches (stats/anti-entropy)
 
     # -- identity -----------------------------------------------------------
 
@@ -164,9 +196,11 @@ class ReplicaConfigServer(ConfigServer):
     def status(self) -> Dict:
         with self._rlock:
             return {"role": self.role, "term": self.term,
-                    "seq": self.seq, "leader": self.leader_base,
+                    "seq": self.seq, "seq_term": self.seq_term,
+                    "leader": self.leader_base,
                     "index": self.index, "base": self.base,
-                    "dead": self.dead}
+                    "dead": self.dead,
+                    "delta_batches": self.delta_batches}
 
     # -- wiring -------------------------------------------------------------
 
@@ -181,6 +215,10 @@ class ReplicaConfigServer(ConfigServer):
             target=self._monitor_loop, name=f"kf-replica-{self.index}",
             daemon=True)
         self._monitor.start()
+        self._committer = threading.Thread(
+            target=self._commit_loop,
+            name=f"kf-replica-commit-{self.index}", daemon=True)
+        self._committer.start()
         return self
 
     def die(self) -> None:
@@ -191,6 +229,8 @@ class ReplicaConfigServer(ConfigServer):
         with self._rlock:
             self.role = "dead"
         self._stop_monitor.set()
+        with self._log_cv:
+            self._log_cv.notify_all()  # wake the committer to drain
         threading.Thread(target=self.stop, daemon=True).start()
 
     # -- monitor: heartbeats out (leader) / lease watch (follower) ----------
@@ -306,27 +346,151 @@ class ReplicaConfigServer(ConfigServer):
         print(f"[kf-replica] r{self.index} deposed at term {term}; "
               "following", flush=True)
 
-    # -- replication push (leader side) -------------------------------------
+    # -- replication: delta log + group commit (leader side) ----------------
 
-    def _on_mutation(self, kind: str) -> None:
+    def _on_mutation(self, kind: str, op: Optional[Dict] = None):
+        """Append the applied mutation to the delta log; the caller
+        (a handler holding ``_mut_mu``) gets back a wait-callable that
+        blocks until the op's batch replicated — replicate-before-ack,
+        amortized. seq is assigned HERE, under the same ``_mut_mu``
+        critical section that applied the mutation, so log order ==
+        application order and the leader's state at seq N is exactly
+        the replay of ops 1..N."""
         with self._rlock:
             if self.role != "leader":
+                return None
+            self.seq += 1
+            self.seq_term = self.term
+            entry = {"seq": self.seq, "kind": kind, "op": op,
+                     "ev": threading.Event(), "ok": False}
+        with self._log_cv:
+            self._log.append(entry)
+            self._log_cv.notify()
+        # generous bound: a full commit window + a per-follower push
+        # round; on timeout the handler answers 503 and the client
+        # retries (never acks an unreplicated write)
+        wait_s = max(2.0, 4.0 * self.lease_ms / 1e3
+                     + self.commit_ms / 1e3)
+
+        def _wait() -> bool:
+            entry["ev"].wait(wait_s)
+            return bool(entry["ok"])
+
+        return _wait
+
+    def _commit_loop(self) -> None:
+        """Group-commit flusher: sleep until ops arrive, accumulate
+        for up to KF_CP_COMMIT_MS (or _MAX_DELTA_BATCH), push once."""
+        while True:
+            with self._log_cv:
+                while not self._log and not self._stop_monitor.is_set():
+                    self._log_cv.wait(0.25)
+                if not self._stop_monitor.is_set() and \
+                        self.commit_ms > 0:
+                    deadline = time.monotonic() + self.commit_ms / 1e3
+                    while len(self._log) < _MAX_DELTA_BATCH:
+                        rem = deadline - time.monotonic()
+                        if rem <= 0:
+                            break
+                        self._log_cv.wait(rem)
+                batch, self._log = self._log, []
+            if self._stop_monitor.is_set():
+                self._fail(batch)
+                with self._log_cv:
+                    batch, self._log = self._log, []
+                self._fail(batch)
                 return
-        self._push_state()
+            if batch:
+                self._commit(batch)
+
+    @staticmethod
+    def _fail(batch: List[Dict]) -> None:
+        for entry in batch:
+            entry["ev"].set()  # entry["ok"] stays False => 503
+
+    def _commit(self, batch: List[Dict]) -> None:
+        """Push ONE delta batch to every follower, then ack every
+        waiter. A follower that cannot replay (gap/term change)
+        is repaired with a full snapshot before the ack — the 200
+        contract covers repaired followers too."""
+        with self._rlock:
+            live = self.role == "leader" and not self.dead
+            term = self.term
+            peers = list(self.peers)
+        if not live:
+            self._fail(batch)
+            return
+        payload = {"term": term, "leader": self.base,
+                   "ops": [{"seq": e["seq"], "kind": e["kind"],
+                            "op": e["op"]} for e in batch]}
+        fenced = 0
+        for i, peer_base in enumerate(peers):
+            if i == self.index:
+                continue
+            try:
+                out = _rpc(peer_base, "/replica/apply_delta", payload,
+                           timeout=max(0.5, self.lease_ms / 1e3))
+                self._mark_reachable(i)
+                if out.get("gap"):
+                    # restarted / lagging / old-term follower: deltas
+                    # don't land, send the full snapshot
+                    self._push_snapshot_to(i, peer_base)
+            except _RPCReject as e:
+                if e.status == 409:  # term fencing: we are deposed
+                    fenced = max(fenced, int(e.body.get("term", term)))
+            except (OSError, ValueError):
+                # dead or slow follower: it reports `behind` on the
+                # next heartbeat it answers and gets a full push then
+                self._mark_unreachable(i)
+        if fenced:
+            self._step_down(fenced)
+            self._fail(batch)
+            return
+        self.delta_batches += 1
+        for entry in batch:
+            entry["ok"] = True
+            entry["ev"].set()
+        if self.delta_batches % _ANTI_ENTROPY_EVERY == 0:
+            self._push_state()  # bound clock-replay drift (docstring)
+
+    def _push_snapshot_to(self, i: int, peer_base: str) -> None:
+        """Repair ONE follower with a full snapshot at the current
+        (term, seq). Stamped under ``_mut_mu``: no mutation can apply
+        between reading seq and building the snapshot, so the stamp is
+        exact and the follower may drop any delta op <= it (op replay
+        is not idempotent — an inexact stamp would double-apply)."""
+        with self._mut_mu:
+            with self._rlock:
+                if self.role != "leader":
+                    return
+                term, seq = self.term, self.seq
+            payload = {"term": term, "seq": seq, "leader": self.base,
+                       "state": self.state_snapshot()}
+        try:
+            _rpc(peer_base, "/replica/apply", payload,
+                 timeout=max(0.5, self.lease_ms / 1e3))
+            self._mark_reachable(i)
+        except _RPCReject as e:
+            if e.status == 409:
+                self._step_down(int(e.body.get("term", term)))
+        except (OSError, ValueError):
+            self._mark_unreachable(i)
 
     def _push_state(self) -> None:
-        # seq assigned under the lock, snapshot built AFTER — a push
-        # with a higher seq therefore snapshots later, so the highest
-        # seq a follower applies contains every mutation that
-        # triggered a lower one (module docstring: convergence)
-        with self._rlock:
-            if self.role != "leader":
-                return
-            self.seq += 1
-            term, seq = self.term, self.seq
-            peers = list(self.peers)
-        payload = {"term": term, "seq": seq, "leader": self.base,
-                   "state": self.state_snapshot()}
+        """Full-snapshot push to every follower — the repair and
+        takeover path (deltas are the common case). The seq bump and
+        the snapshot are made atomic w.r.t. mutations by ``_mut_mu``
+        (see _push_snapshot_to on why the stamp must be exact)."""
+        with self._mut_mu:
+            with self._rlock:
+                if self.role != "leader":
+                    return
+                self.seq += 1
+                self.seq_term = self.term
+                term, seq = self.term, self.seq
+                peers = list(self.peers)
+            payload = {"term": term, "seq": seq, "leader": self.base,
+                       "state": self.state_snapshot()}
         fenced = 0
         for i, peer_base in enumerate(peers):
             if i == self.index:
@@ -418,6 +582,8 @@ class ReplicaConfigServer(ConfigServer):
             return (400, '{"error": "bad replica rpc body"}')
         if path.startswith("/replica/vote"):
             return self._on_vote(msg)
+        if path.startswith("/replica/apply_delta"):
+            return self._on_apply_delta(msg)
         if path.startswith("/replica/apply"):
             return self._on_apply(msg)
         if path.startswith("/replica/heartbeat"):
@@ -450,23 +616,108 @@ class ReplicaConfigServer(ConfigServer):
                 if req_term < self.term:
                     return (409, json.dumps(
                         {"error": "stale term", "term": self.term}))
-                newer_term = req_term > self.term
                 self.term = req_term
                 if self.role == "leader" and \
                         str(msg.get("leader", "")) != self.base:
                     self.role = "follower"
                 self.leader_base = str(msg.get("leader", ""))
                 self._hb_t = time.monotonic()
-                if not newer_term and req_seq <= self.seq:
-                    # duplicate or out-of-order push within the same
-                    # term: the state we hold is at least as new
+                if req_term == self.seq_term and req_seq <= self.seq:
+                    # duplicate or out-of-order push within the seq
+                    # domain we're on: the state we hold is newer
                     return (200, json.dumps({"ok": True,
                                              "seq": self.seq}))
-                # a NEW term restarts the seq domain (the new leader
-                # counts from its own replicated seq) — apply it
+                # a NEW seq domain (fresh leader) or a catch-up within
+                # ours — apply it. Comparing seq_term (not term) keeps
+                # a follower that adopted the term via heartbeat from
+                # dropping the new leader's catch-up snapshot just
+                # because its stale seq happens to be numerically
+                # higher.
                 self.seq = req_seq
+                self.seq_term = req_term
             self.state_restore(msg["state"])
         return (200, json.dumps({"ok": True, "seq": req_seq}))
+
+    def _on_apply_delta(self, msg: Dict):
+        """Replay a delta batch in strict seq order. Already-applied
+        ops (covered by a snapshot stamp) are dropped; the first
+        non-contiguous op stops the replay and reports ``gap`` so the
+        leader repairs with a full snapshot."""
+        req_term = int(msg.get("term", 0))
+        ops = msg.get("ops") or []
+        with self._apply_mu:  # serialize with snapshot restores
+            with self._rlock:
+                if req_term < self.term:
+                    return (409, json.dumps(
+                        {"error": "stale term", "term": self.term}))
+                self.term = req_term
+                if self.role == "leader" and \
+                        str(msg.get("leader", "")) != self.base:
+                    self.role = "follower"
+                self.leader_base = str(msg.get("leader", ""))
+                self._hb_t = time.monotonic()
+                if req_term != self.seq_term:
+                    # our state belongs to another term's seq domain:
+                    # deltas can't replay onto it, ask for a snapshot
+                    return (200, json.dumps({"gap": True,
+                                             "seq": self.seq}))
+                fresh = [o for o in ops
+                         if int(o.get("seq", 0)) > self.seq]
+                if not fresh:
+                    return (200, json.dumps({"ok": True,
+                                             "seq": self.seq}))
+                run: List[Dict] = []
+                expect = self.seq + 1
+                for o in fresh:
+                    if int(o["seq"]) != expect:
+                        break  # a full-push bump consumed a seq
+                    run.append(o)
+                    expect += 1
+                if not run:
+                    return (200, json.dumps({"gap": True,
+                                             "seq": self.seq}))
+                gap = len(run) < len(fresh)
+                self.seq = int(run[-1]["seq"])
+                seq = self.seq
+            for o in run:  # outside _rlock: ops take their own locks
+                self._apply_op(str(o.get("kind", "")),
+                               o.get("op") or {})
+        if gap:
+            return (200, json.dumps({"gap": True, "seq": seq}))
+        return (200, json.dumps({"ok": True, "seq": seq}))
+
+    def _apply_op(self, kind: str, op: Dict) -> None:
+        """Replay one logged mutation against local state — the same
+        dispatch the leader's handler ran, minus HTTP."""
+        method = str(op.get("method", "POST"))
+        path = str(op.get("path", ""))
+        body = str(op.get("body", ""))
+        try:
+            if kind == "serve":
+                from ..serve.frontend import handle_serve
+
+                handle_serve(self.serve_ledger, method, path, body)
+            elif kind == "trace":
+                self.trace_store.add_batch(json.loads(body))
+            elif kind == "stage":
+                from ..peer import Stage as _Stage
+
+                if path.startswith("/put"):
+                    self._put(_Stage.from_json(body))
+                elif path.startswith("/addworker"):
+                    self._resize(+1)
+                elif path.startswith("/removeworker"):
+                    self._resize(-1)
+                elif path.startswith("/clear"):
+                    self._clear()
+                elif path.startswith("/reset"):
+                    self._reset()
+        except (ValueError, KeyError, TypeError) as e:
+            # an op that succeeded on the leader must replay cleanly;
+            # divergence here is repaired by the next full push, but
+            # say so loudly
+            print(f"[kf-replica] r{self.index}: delta replay failed "
+                  f"({kind} {path}): {e}", flush=True)
 
     def _on_heartbeat(self, msg: Dict):
         req_term = int(msg.get("term", 0))
@@ -481,7 +732,11 @@ class ReplicaConfigServer(ConfigServer):
             if self.role != "leader":
                 self.leader_base = str(msg.get("leader", ""))
                 self._hb_t = time.monotonic()
-            behind = self.seq < int(msg.get("seq", 0))
+            # a seq from another term's domain is incomparable: we are
+            # behind that leader until its snapshot lands, whatever
+            # the numbers say
+            behind = self.seq_term != req_term or \
+                self.seq < int(msg.get("seq", 0))
         return (200, json.dumps({"behind": behind, "term": req_term}))
 
     # -- read staleness + chaos ---------------------------------------------

@@ -32,6 +32,15 @@ CONFIG_VARS = (
     # KF_CONFIG_LEASE_MS is the leader lease (election timeout scale)
     "KF_CONFIG_SERVERS",
     "KF_CONFIG_LEASE_MS",
+    # control-plane fast path (docs/control_plane.md "Delta log"):
+    # KF_CP_COMMIT_MS is the leader's group-commit accumulation window
+    # (0 = flush each mutation immediately, i.e. batching off);
+    # KF_SERVE_ROUTERS lists the stateless admission routers clients
+    # fail over across (same base-URL shape as KF_CONFIG_SERVERS);
+    # KF_ROUTER_FLUSH_MS is the router's submit-coalescing window
+    "KF_CP_COMMIT_MS",
+    "KF_SERVE_ROUTERS",
+    "KF_ROUTER_FLUSH_MS",
     "KF_LOG_LEVEL",
     "KF_STALL_DETECTION",
     "KF_TIMEOUT_MS",
@@ -301,6 +310,9 @@ def from_env(environ: Optional[Dict[str, str]] = None) -> Config:
     # replicated control plane (docs/control_plane.md)
     env_server_list(CONFIG_SERVERS, e)
     env_float("KF_CONFIG_LEASE_MS", 2000.0, e, minimum=100.0)
+    env_float("KF_CP_COMMIT_MS", 2.0, e, minimum=0.0)
+    env_server_list("KF_SERVE_ROUTERS", e)
+    env_float("KF_ROUTER_FLUSH_MS", 2.0, e, minimum=0.0)
     self_spec = e.get(SELF_SPEC, "")
     if not self_spec:
         solo = PeerID.from_host("127.0.0.1", 0)

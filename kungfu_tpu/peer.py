@@ -9,14 +9,17 @@ separately in kungfu_tpu.parallel — this class is pure DCN control.
 
 from __future__ import annotations
 
+import http.client
+import io
 import json
 import os
+import socket
 import threading
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from . import env as kfenv
 from . import ffi
@@ -53,30 +56,45 @@ class Stage:
         return self.version.to_bytes(4, "little") + self.cluster.to_bytes()
 
 
-# -- replica-aware HTTP verbs (docs/control_plane.md) ------------------------
+# -- replica-aware keep-alive HTTP verbs (docs/control_plane.md) --------------
 #
 # With KF_CONFIG_SERVERS set, every consumer of fetch_url/put_url/
 # post_url — resize polls, watcher recovery proposals, serve workers,
 # TraceShipper, SLOPolicy stats — gains replica failover WITHOUT
 # per-call-site changes: a URL whose scheme://netloc matches one of the
-# listed replica bases is retargeted across the tier. Two mechanisms,
-# both inside one HTTP *attempt* (the caller's RetryPolicy still owns
-# backoff between attempts):
+# listed replica bases is retargeted across the tier. KF_SERVE_ROUTERS
+# gets the same treatment for the admission-router front door. Three
+# mechanisms, all inside one HTTP *attempt* (the caller's RetryPolicy
+# still owns backoff between attempts):
 #
-# - **307 following**: a follower redirects writes to the leader;
-#   urllib's redirect handler refuses to re-send a body on 307, so the
-#   hop is followed manually (bounded), preserving method + body.
+# - **307 following**: a follower redirects writes to the leader; the
+#   hop is followed manually (bounded), preserving method + body. When
+#   a redirect points at a corpse (a follower vouching for a just-dead
+#   leader), the hop re-resolves across KF_CONFIG_SERVERS instead of
+#   burning the whole attempt on one dead address.
 # - **candidate rotation**: a connection-LEVEL failure (refused/reset/
 #   timeout — retrying.is_conn_failure) moves to the next replica; an
 #   HTTP-level error (e.g. 503 mid-election) raises to the retry
 #   policy, whose backoff is the right medicine for "no leader yet".
+# - **connection pooling**: requests ride per-(scheme, host, port)
+#   keep-alive connections, so the per-iteration serve traffic
+#   (append_batch, resize polls) stops paying TCP connect + a fresh
+#   server-side handler thread per call. A reused connection the
+#   server idled out gets ONE transparent resend on a fresh socket.
 #
 # The last replica that actually answered (post-redirect, so usually
-# the leader) is remembered and tried first next time.
+# the leader) is remembered and tried first next time; the leader
+# learned from a write (direct 200 or a 307 Location) is additionally
+# pinned first for subsequent writes.
 
 _MAX_REDIRECT_HOPS = 4
+_POOL_MAX_PER_HOST = 4
 _replica_mu = threading.Lock()
 _preferred_replica = ""  # kf: guarded_by(_replica_mu)
+_leader_hint = ""  # kf: guarded_by(_replica_mu)
+_pool_mu = threading.Lock()
+_pool: Dict[str, List[http.client.HTTPConnection]] = {}  # kf: guarded_by(_pool_mu)
+_pool_stats = {"opened": 0, "reused": 0}  # kf: guarded_by(_pool_mu)
 
 
 def _replica_bases() -> tuple:
@@ -84,59 +102,210 @@ def _replica_bases() -> tuple:
     return kfenv.env_server_list(kfenv.CONFIG_SERVERS)
 
 
+def _router_bases() -> tuple:
+    """The configured admission-router tier (validated bases), or ()."""
+    return kfenv.env_server_list("KF_SERVE_ROUTERS")
+
+
 def _url_base(url: str) -> str:
     parts = urllib.parse.urlsplit(url)
     return f"{parts.scheme}://{parts.netloc}"
 
 
-def _failover_candidates(url: str) -> list:
-    """URLs to try for one attempt, preferred replica first. A URL
-    outside the configured tier (file://, a worker's own front-end)
-    passes through untouched."""
-    bases = _replica_bases()
-    if not bases:
-        return [url]
+def _failover_candidates(url: str, write: bool = False) -> list:
+    """URLs to try for one attempt, best-guess base first. A URL
+    outside both configured tiers (file://, a worker's own front-end)
+    passes through untouched. Routers are stateless, so router URLs
+    just rotate; replica URLs are additionally ordered leader-first
+    for writes (the leader hint) and last-responder-first otherwise."""
     base = _url_base(url)
-    if base not in bases:
+    routers = _router_bases()
+    if base in routers:
+        order = [base] + [b for b in routers if b != base]
+        suffix = url[len(base):]
+        return [b + suffix for b in order]
+    bases = _replica_bases()
+    if not bases or base not in bases:
         return [url]
     with _replica_mu:
         preferred = _preferred_replica
+        leader = _leader_hint
     order = [base] + [b for b in bases if b != base]
-    if preferred in order and preferred != base:
-        order.remove(preferred)
-        order.insert(0, preferred)
+    for hint in (preferred, leader if write else ""):
+        if hint in order and hint != order[0]:
+            order.remove(hint)
+            order.insert(0, hint)
     suffix = url[len(base):]
     return [b + suffix for b in order]
 
 
-def _remember_replica(url: str) -> None:
-    global _preferred_replica
+def _remember_replica(url: str, write: bool = False) -> None:
+    global _preferred_replica, _leader_hint
     base = _url_base(url)
     if base in _replica_bases():
         with _replica_mu:
             _preferred_replica = base
+            if write:  # a write only succeeds at the leader
+                _leader_hint = base
+
+
+def _forget_leader(base: str) -> None:
+    global _leader_hint
+    with _replica_mu:
+        if _leader_hint == base:
+            _leader_hint = ""
+
+
+def _pool_take(key: str) -> Optional[http.client.HTTPConnection]:
+    with _pool_mu:
+        conns = _pool.get(key)
+        if conns:
+            _pool_stats["reused"] += 1
+            return conns.pop()
+    return None
+
+
+def _pool_put(key: str, conn: http.client.HTTPConnection) -> None:
+    with _pool_mu:
+        conns = _pool.setdefault(key, [])
+        if len(conns) < _POOL_MAX_PER_HOST:
+            conns.append(conn)
+            return
+    conn.close()
+
+
+def pool_stats() -> dict:
+    with _pool_mu:
+        return dict(_pool_stats)
+
+
+def reset_transport() -> None:
+    """Close every pooled connection and drop cached hints (tests)."""
+    global _preferred_replica, _leader_hint
+    with _pool_mu:
+        drained = [c for conns in _pool.values() for c in conns]
+        _pool.clear()
+        _pool_stats["opened"] = 0
+        _pool_stats["reused"] = 0
+    for conn in drained:
+        try:
+            conn.close()
+        except OSError:
+            pass
+    with _replica_mu:
+        _preferred_replica = ""
+        _leader_hint = ""
+
+
+def _request_once(target: str, method: str, body: Optional[bytes],
+                  timeout: float) -> Tuple[int, bytes, "http.client.HTTPMessage"]:
+    """One HTTP exchange over a pooled keep-alive connection.
+
+    Returns (status, body_bytes, headers) for EVERY status — HTTP-level
+    errors are classified by the caller, not raised here. Connection-
+    level failures raise OSError subclasses (retrying.is_conn_failure's
+    class). A reused connection that the server closed while idle gets
+    one transparent resend on a fresh socket — safe because the request
+    demonstrably never reached a handler (the stale-FIN race)."""
+    parts = urllib.parse.urlsplit(target)
+    key = f"{parts.scheme}://{parts.netloc}"
+    path = parts.path or "/"
+    if parts.query:
+        path += "?" + parts.query
+    headers = {"Content-Type": "application/json"} \
+        if body is not None else {}
+    conn_cls = http.client.HTTPSConnection if parts.scheme == "https" \
+        else http.client.HTTPConnection
+    for attempt in (0, 1):
+        conn = _pool_take(key) if attempt == 0 else None
+        reused = conn is not None
+        if conn is None:
+            conn = conn_cls(parts.hostname, parts.port, timeout=timeout)
+            with _pool_mu:
+                _pool_stats["opened"] += 1
+            try:
+                # connect eagerly to disable Nagle: a keep-alive
+                # request is a small write-write-read, and Nagle +
+                # delayed ACK turns every round trip into a ~40 ms
+                # stall (one-shot urlopen never noticed — the close
+                # flushed it)
+                conn.connect()
+                conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+            except OSError:
+                conn.close()
+                raise
+        else:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except (http.client.RemoteDisconnected, ConnectionResetError,
+                BrokenPipeError):
+            conn.close()
+            if reused:
+                continue  # idle keep-alive conn died under us; resend fresh
+            raise
+        except Exception:
+            conn.close()
+            raise
+        if resp.will_close:
+            conn.close()
+        else:
+            _pool_put(key, conn)
+        return resp.status, data, resp.headers
+    raise http.client.RemoteDisconnected("pooled connection resend failed")
 
 
 def _open_following_redirects(url: str, method: str,
                               body: Optional[bytes],
-                              timeout: float):
-    """urlopen that follows same-method 307/308 hops (the follower→
-    leader write-redirect contract). Returns (final_url, response)."""
+                              timeout: float) -> Tuple[str, str]:
+    """Keep-alive request that follows same-method 307/308 hops (the
+    follower→leader write-redirect contract) and re-resolves from
+    KF_CONFIG_SERVERS when a redirect targets a dead address. Returns
+    (final_url, response_text); statuses >= 400 raise HTTPError so the
+    retrying taxonomy sees the same exception shapes as urllib."""
     target = url
+    suffix = url[len(_url_base(url)):]
+    redirected = False
+    dead: set = set()
+    tried = {_url_base(url)}
     for _ in range(_MAX_REDIRECT_HOPS):
-        headers = {"Content-Type": "application/json"} \
-            if body is not None else {}
-        req = urllib.request.Request(target, data=body, method=method,
-                                     headers=headers)
         try:
-            return target, urllib.request.urlopen(req, timeout=timeout)
-        except urllib.error.HTTPError as e:
-            loc = e.headers.get("Location") if e.code in (307, 308) \
-                else None
-            if not loc:
+            status, data, hdrs = _request_once(target, method, body, timeout)
+        except Exception as e:  # noqa: BLE001 — split below
+            base = _url_base(target)
+            if not (redirected and retrying.is_conn_failure(e)):
                 raise
-            e.close()
-            target = urllib.parse.urljoin(target, loc)
+            # the redirect pointed at a corpse: forget the hint and
+            # re-resolve across the tier instead of failing the attempt.
+            # Each base is re-resolved to at most once — when they're
+            # exhausted the conn failure raises, and the caller's
+            # candidate rotation / retry policy takes over.
+            _forget_leader(base)
+            dead.add(base)
+            alt = [b for b in _replica_bases()
+                   if b not in dead and b not in tried]
+            if not alt:
+                raise
+            tried.add(alt[0])
+            target = alt[0] + suffix
+            redirected = False
+            continue
+        if status in (307, 308) and hdrs.get("Location"):
+            target = urllib.parse.urljoin(target, hdrs["Location"])
+            if method != "GET":  # the redirect target IS the leader
+                _remember_replica(target, write=True)
+            redirected = True
+            continue
+        if status >= 400:
+            raise urllib.error.HTTPError(
+                target, status, data.decode(errors="replace")[:200],
+                hdrs, io.BytesIO(data))
+        return target, data.decode()
     raise urllib.error.HTTPError(
         target, 508, "redirect loop across config replicas", None, None)
 
@@ -152,18 +321,18 @@ def _control_request(url: str, method: str = "GET",
         with urllib.request.urlopen(url, timeout=timeout) as r:
             return r.read().decode()
     data = body.encode() if body is not None else None
-    candidates = _failover_candidates(url)
+    write = method != "GET"
+    candidates = _failover_candidates(url, write=write)
     last: Optional[BaseException] = None
     for i, candidate in enumerate(candidates):
         try:
-            final, resp = _open_following_redirects(
+            final, out = _open_following_redirects(
                 candidate, method, data, timeout)
-            with resp:
-                out = resp.read().decode()
-            _remember_replica(final)
+            _remember_replica(final, write=write)
             return out
         except Exception as e:  # noqa: BLE001 — split below
             if i + 1 < len(candidates) and retrying.is_conn_failure(e):
+                _forget_leader(_url_base(candidate))
                 last = e
                 continue  # this replica is unreachable; try a sibling
             raise

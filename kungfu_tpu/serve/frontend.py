@@ -13,6 +13,10 @@ Routes (all JSON):
   -> {"id": k} | 429 when the bounded admission queue is full
   (transient in the retrying.py taxonomy: clients back off and
   retry) | 400 on malformed input (permanent: never retried).
+- ``POST /serve/submit_batch`` {"rows": [{"prompt", "max_new_tokens"},
+  ...]} -> {"results": [{"id": k} | {"error", "code"}, ...]} — the
+  admission router's coalescing verb: one ledger write (and one
+  replication op) admits a whole flush window; rejection is per-row.
 - ``GET  /serve/result?id=k`` -> request record (state/tokens/
   latency) | 404.
 - ``GET  /serve/stats`` -> ledger stats (queue depth, in-flight,
@@ -51,9 +55,9 @@ from ..peer import fetch_url, post_url
 from .ledger import AdmissionFull, RequestLedger
 
 __all__ = [
-    "handle_serve", "serve_url", "submit", "result", "results",
-    "stats", "invariants", "lease", "append", "append_batch",
-    "release", "RequestLedger",
+    "handle_serve", "serve_url", "submit", "submit_batch", "result",
+    "results", "stats", "invariants", "lease", "append",
+    "append_batch", "release", "RequestLedger",
 ]
 
 
@@ -74,6 +78,9 @@ def handle_serve(ledger: RequestLedger, method: str, path: str,
             rid = ledger.submit(list(doc.get("prompt", [])),
                                 int(doc.get("max_new_tokens", 0)))
             return 200, json.dumps({"id": rid})
+        if method == "POST" and route == "/serve/submit_batch":
+            results_ = ledger.submit_batch(list(doc.get("rows", [])))
+            return 200, json.dumps({"results": results_})
         if method == "POST" and route == "/serve/lease":
             out = ledger.lease(int(doc.get("max", 1)),
                                str(doc.get("worker", "")))
@@ -131,6 +138,17 @@ def submit(url: str, prompt: List[int], max_new_tokens: int,
                                "max_new_tokens": max_new_tokens}),
                    retry=retry)
     return int(json.loads(out)["id"])
+
+
+def submit_batch(url: str, rows: List[Dict], retry=None) -> List[Dict]:
+    """Coalesced admission (the router's ledger-side verb): one POST
+    admits many prompts; per-row outcome dicts ({"id": k} or
+    {"error": ..., "code": 429|400}) come back in row order, so one
+    full queue rejects only the rows that didn't fit, not the whole
+    batch."""
+    out = post_url(serve_url(url, "/submit_batch"),
+                   json.dumps({"rows": rows}), retry=retry)
+    return list(json.loads(out)["results"])
 
 
 def result(url: str, rid: int, retry=None) -> Dict:

@@ -147,6 +147,26 @@ class RequestLedger:
             metrics.REGISTRY.set("kf_serve_queue_depth", depth + 1)
         return rid
 
+    def submit_batch(self, rows: List[Dict]) -> List[Dict]:
+        """Admit many prompts in one call — the router's coalescing
+        verb, and ONE replicated op on the tier. Per-row outcomes in
+        row order: {"id": k} on admission, {"error", "code"} on a
+        full queue (429, transient) or malformed row (400, permanent).
+        Row order is what makes replay deterministic: a follower
+        replaying this op assigns the same ids in the same order."""
+        out: List[Dict] = []
+        for row in rows:
+            try:
+                rid = self.submit(
+                    list(row.get("prompt", [])),
+                    int(row.get("max_new_tokens", 0)))
+                out.append({"id": rid})
+            except AdmissionFull as e:
+                out.append({"error": str(e), "code": 429})
+            except (ValueError, TypeError, AttributeError) as e:
+                out.append({"error": str(e), "code": 400})
+        return out
+
     # -- worker side --------------------------------------------------------
 
     def _reclaim_locked(self, now: float) -> None:

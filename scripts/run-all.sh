@@ -344,6 +344,121 @@ print(f"CONTROL-PLANE SMOKE OK: leader r{dead[0]} killed mid-resize, "
       f"12/12 served, stage v{versions[0]} on both survivors")
 EOF
 
+echo "== [4j/7] admission routers: kill a router mid-traffic, zero drops =="
+# the stateless admission tier (docs/serving.md): two routers front a
+# 3-replica config tier serving the SAME 2-worker decode cluster, all
+# client traffic (submits AND result polls) enters through the
+# routers, and a kill_router chaos fault permanently kills router 0
+# mid-burst. Routers hold no request state — pending un-acked submits
+# die with the router and the client lap-loop resubmits on the
+# survivor — so the gate is the tier's whole point: every request
+# completes exactly once and the ledger invariants stay clean.
+timeout 400 python - <<'EOF'
+from kungfu_tpu import chaos
+from kungfu_tpu.elastic.replica import ReplicaTier
+from kungfu_tpu.retrying import NO_RETRY
+from kungfu_tpu.serve import frontend
+from kungfu_tpu.serve.harness import default_requests, run_serve_cluster
+from kungfu_tpu.serve.router import Router
+import time
+
+
+class RouterFront:
+    """ConfigServer duck-type for run_serve_cluster with the request
+    plane re-pointed at the router tier: workers still talk straight
+    to the config tier (get_url), but every feeder submit/result/
+    stats/invariants call enters through a router."""
+
+    def __init__(self, tier, routers):
+        self.tier = tier
+        self.routers = routers
+
+    @property
+    def get_url(self):
+        return self.tier.get_url
+
+    @property
+    def serve_ledger(self):
+        return self
+
+    def _call(self, fn, deadline_s=30.0):
+        last = None
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            for r in self.routers:
+                if r.dead:
+                    continue
+                try:
+                    return fn(r.base)
+                except (OSError, ValueError) as e:
+                    last = e  # killed router: lap to the survivor
+            time.sleep(0.05)
+        raise TimeoutError(f"no router answered: {last}")
+
+    def submit(self, prompt, max_new):
+        return self._call(lambda b: frontend.submit(
+            b, prompt, max_new, retry=NO_RETRY))
+
+    def result(self, rid):
+        return self._call(lambda b: frontend.result(
+            b, rid, retry=NO_RETRY))
+
+    def stats(self):
+        return self._call(lambda b: frontend.stats(b, retry=NO_RETRY))
+
+    def check_invariants(self):
+        return self._call(lambda b: frontend.invariants(
+            b, retry=NO_RETRY))
+
+    # scenario ledger knobs pass through to the real tier
+    @property
+    def lease_ms(self):
+        return self.tier.serve_ledger.lease_ms
+
+    @lease_ms.setter
+    def lease_ms(self, v):
+        self.tier.serve_ledger.lease_ms = v
+
+    @property
+    def max_queue(self):
+        return self.tier.serve_ledger.max_queue
+
+    @max_queue.setter
+    def max_queue(self, v):
+        self.tier.serve_ledger.max_queue = v
+
+
+tier = ReplicaTier(n=3, lease_ms=500.0)
+routers = []
+try:
+    routers = [Router(tier.bases, index=i).start() for i in range(2)]
+    chaos.load({"faults": [{"type": "kill_router", "router": 0,
+                            "after_requests": 5}]})
+    front = RouterFront(tier, routers)
+    out = run_serve_cluster(
+        default_requests(12, gen_len=12), start_np=2, server=front,
+        extra_env={**tier.env(), "KF_SERVE_MAX_BATCH": "4",
+                   "KF_SERVE_LEASE_MS": "3000"},
+        port_range="26000-26999", timeout=360)
+    st = out["stats"]
+    assert st["failed"] == 0 and st["done"] == 12, st
+    assert routers[0].dead, "chaos never killed router 0"
+    assert not routers[1].dead, "survivor router died too"
+    hz = routers[1].healthz()
+    assert hz["submitted"] > 0, hz
+    viol = front.check_invariants()
+    assert viol == [], viol
+finally:
+    for r in routers:
+        r.stop()
+    tier.stop()
+    chaos.load(None)
+    chaos._reset()
+print(f"ROUTER SMOKE OK: router 0 killed mid-traffic, 12/12 served "
+      f"through survivor (submitted {hz['submitted']} there), "
+      f"zero drops")
+EOF
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

@@ -8,6 +8,7 @@ spawns a cold python+jax boot inside the measured window.
 
 import json
 import os
+import select
 import subprocess
 import sys
 import textwrap
@@ -59,10 +60,21 @@ def test_activation_latency_is_subsecond(tmp_path):
     """The point of the pool: once warm, activation->exit of a trivial
     worker is far below the ~2s cold python+jax import cost."""
     proc = spawn_prewarm(tmp_path, "print('fast')")
-    # let the child finish its imports; a still-importing child only
-    # makes the measured activation time LARGER, so this can't flake
-    # toward a false pass
-    time.sleep(8.0)
+    # wait for the child's OWN readiness marker instead of a fixed
+    # sleep: under a loaded CI box the imports can take arbitrarily
+    # long (the old 8s nap flaked), and a still-importing child only
+    # makes the measured activation time LARGER — so poll the marker
+    # with a wide deadline and only then start the clock
+    buf = b""
+    deadline = time.time() + 120.0
+    while b"KF_WARM_READY" not in buf:
+        assert time.time() < deadline, \
+            f"no KF_WARM_READY within 120s; got {buf!r}"
+        ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if ready:
+            chunk = os.read(proc.stdout.fileno(), 4096)
+            assert chunk, f"prewarm EOF before readiness; got {buf!r}"
+            buf += chunk
     assert proc.poll() is None, "prewarm exited before activation"
     t0 = time.time()
     out, _ = proc.communicate(input=b"{}\n", timeout=60)

@@ -1,0 +1,172 @@
+"""Admission-router tests (serve/router.py): the stateless front door.
+
+What must hold: a router terminates /serve/submit + the read verbs and
+NOTHING else; concurrent submits coalesce into fewer ledger writes
+than clients (the group-commit amortization, front-door edition); and
+a router death mid-traffic drops ZERO requests — un-acked submits die
+with the connection and the client's retry resubmits through a
+surviving router (KF_SERVE_ROUTERS failover in peer.py), while acked
+ids are ledger-durable by replicate-before-ack.
+"""
+
+import json
+import threading
+import urllib.error
+
+import pytest
+
+
+def _base(server) -> str:
+    return f"http://{server.host}:{server.port}"
+
+
+@pytest.fixture()
+def router_stack():
+    """One config server + one router in front (flush window wide
+    enough that concurrent submits actually coalesce)."""
+    import importlib
+
+    from kungfu_tpu import chaos
+    from kungfu_tpu.elastic.config_server import ConfigServer
+    from kungfu_tpu.serve.router import Router
+
+    peer_mod = importlib.import_module("kungfu_tpu.peer")
+    server = ConfigServer(port=0).start()
+    router = Router([_base(server)], flush_ms=25.0).start()
+    try:
+        yield server, router
+    finally:
+        router.stop()
+        server.stop()
+        chaos.load(None)
+        chaos._reset()
+        peer_mod.reset_transport()
+
+
+class TestRouter:
+    def test_submit_result_roundtrip_and_routing_surface(
+            self, router_stack):
+        """One submit through the router lands in the ledger behind
+        it; reads forward; everything that is NOT the front door
+        (membership, worker verbs) answers 404 — routers must never
+        grow into a second control plane."""
+        from kungfu_tpu.peer import fetch_url, post_url
+        from kungfu_tpu.retrying import NO_RETRY
+        from kungfu_tpu.serve import frontend
+
+        server, router = router_stack
+        rid = frontend.submit(router.base, [1, 2, 3], 4,
+                              retry=NO_RETRY)
+        assert server.serve_ledger.result(rid)["state"] == "queued"
+        assert frontend.result(router.base, rid,
+                               retry=NO_RETRY)["state"] == "queued"
+        assert frontend.stats(router.base,
+                              retry=NO_RETRY)["submitted"] == 1
+        assert frontend.invariants(router.base, retry=NO_RETRY) == []
+        hz = json.loads(fetch_url(router.base + "/healthz",
+                                  retry=NO_RETRY))
+        assert hz["role"] == "router" and hz["submitted"] == 1
+        # upstream errors forward with their status: unknown id -> 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            frontend.result(router.base, 999, retry=NO_RETRY)
+        assert ei.value.code == 404
+        # malformed submit -> the ledger's 400, forwarded per-row
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post_url(router.base + "/serve/submit",
+                     json.dumps({"prompt": [], "max_new_tokens": 4}),
+                     retry=NO_RETRY)
+        assert ei.value.code == 400
+        # not the front door: membership and worker verbs 404 here
+        for path, body in [("/put", "{}"), ("/addworker", "{}"),
+                           ("/serve/lease",
+                            '{"max": 1, "worker": "w0"}')]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post_url(router.base + path, body, retry=NO_RETRY)
+            assert ei.value.code == 404, path
+
+    def test_concurrent_submits_coalesce_into_fewer_writes(
+            self, router_stack):
+        """The amortization claim itself: N concurrent submits become
+        strictly fewer than N ledger writes (one flush window admits a
+        whole burst), every client still gets a unique ledger id."""
+        from kungfu_tpu.retrying import NO_RETRY
+        from kungfu_tpu.serve import frontend
+
+        server, router = router_stack
+        n = 8
+        ids, errs = [], []
+        start = threading.Barrier(n)
+
+        def one(k):
+            try:
+                start.wait(5)
+                rid = frontend.submit(router.base, [10 + k], 2,
+                                      retry=NO_RETRY)
+                with lock:
+                    ids.append(rid)
+            except Exception as e:  # noqa: BLE001 — the test FAILS on any
+                errs.append(e)
+
+        lock = threading.Lock()
+        threads = [threading.Thread(target=one, args=(k,))
+                   for k in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert errs == [], errs
+        assert len(ids) == len(set(ids)) == n
+        assert router.flushed_batches < n, \
+            f"{router.flushed_batches} flushes for {n} submits: " \
+            "no coalescing happened"
+        assert router.submitted == n
+        assert server.serve_ledger.stats()["submitted"] == n
+        assert server.serve_ledger.check_invariants() == []
+
+
+@pytest.mark.chaos
+def test_router_death_mid_traffic_drops_zero_requests(monkeypatch):
+    """kill_router fires on router 0 mid-burst. Clients listing both
+    routers in KF_SERVE_ROUTERS must land every single submit: the
+    in-flight one dies un-acked with the connection (peer.py fails
+    over to router 1 and resubmits), and every id EVER acked to a
+    client exists in the ledger exactly once."""
+    import importlib
+
+    from kungfu_tpu import chaos
+    from kungfu_tpu.elastic.config_server import ConfigServer
+    from kungfu_tpu.retrying import RetryPolicy
+    from kungfu_tpu.serve import frontend
+    from kungfu_tpu.serve.router import Router
+
+    peer_mod = importlib.import_module("kungfu_tpu.peer")
+    server = ConfigServer(port=0).start()
+    r0 = Router([_base(server)], index=0, flush_ms=2.0).start()
+    r1 = Router([_base(server)], index=1, flush_ms=2.0).start()
+    monkeypatch.setenv("KF_SERVE_ROUTERS", f"{r0.base},{r1.base}")
+    patient = RetryPolicy(attempts=8, base_ms=50.0, max_ms=400.0,
+                          deadline_s=20.0, name="test-router-failover")
+    try:
+        chaos.load({"faults": [{"type": "kill_router", "router": 0,
+                                "after_requests": 5}]})
+        ids = []
+        for k in range(20):
+            # every submit AIMS at r0; after the kill, peer.py's
+            # router rotation lands it on r1 — no client-side special
+            # casing, no dropped request
+            ids.append(frontend.submit(r0.base, [200 + k], 2,
+                                       retry=patient))
+        assert len(ids) == len(set(ids)) == 20
+        assert r0.dead and not r1.dead
+        assert r1.healthz()["submitted"] >= 15
+        ledger_ids = {r["id"] for r in server.serve_ledger.results()}
+        assert set(ids) <= ledger_ids
+        assert server.serve_ledger.stats()["submitted"] == 20
+        assert server.serve_ledger.check_invariants() == []
+    finally:
+        r0.stop()
+        r1.stop()
+        server.stop()
+        chaos.load(None)
+        chaos._reset()
+        peer_mod.reset_transport()
