@@ -513,6 +513,209 @@ def measure_takeover(mode: str, lease_ms: float) -> Dict[str, float]:
     return d
 
 
+def measure_durability(lease_ms: float, ops: int,
+                       submits: int) -> Dict[str, Dict[str, float]]:
+    """The durability price: the SAME n=3 admission burst as
+    `measure_replication_cost`, but with every replica writing its
+    write-ahead log — fsync on (the durable default: ONE fsync per
+    group-commit window) vs `KF_CP_FSYNC=0` (same writes, no sync).
+    The delta between the two is what the disk's sync latency costs;
+    the delta against the memory-only row is the WAL's full price."""
+    import os
+    import shutil
+    import tempfile
+
+    rows: Dict[str, Dict[str, float]] = {}
+    for label, fsync in (("fsync_on", "1"), ("fsync_off", "0")):
+        d = tempfile.mkdtemp(prefix="kf-cp-wal-bench-")
+        saved = {k: os.environ.get(k)
+                 for k in ("KF_CP_WAL_DIR", "KF_CP_FSYNC")}
+        os.environ["KF_CP_WAL_DIR"] = d
+        os.environ["KF_CP_FSYNC"] = fsync
+        try:
+            rows[label] = measure_replication_cost(
+                3, lease_ms, ops, submits)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            shutil.rmtree(d, ignore_errors=True)
+    return rows
+
+
+def measure_recovery(lease_ms: float,
+                     lengths=(64, 256, 1024, 4096)
+                     ) -> List[Dict[str, float]]:
+    """Replica recovery time vs WAL length: acked-op history of each
+    size, then a crash + relaunch-from-WAL, reporting the WAL's own
+    replay clock. Two series: compaction effectively OFF (the
+    replay-grows-with-history shape) and ON at 128 ops (replay =
+    snapshot + <=128 ops, flat in total history) — the table that
+    shows KF_CP_WAL_COMPACT_OPS bounds replay. The history is
+    membership add/remove pairs, whose STATE stays bounded (worker
+    count in {1, 2}) however long the history grows — so the compact
+    series isolates log length, not snapshot size. Measured on a
+    SINGLE-member durable tier with anti-entropy ablated: in a
+    multi-member tier every full-push repair (heartbeat-behind,
+    anti-entropy, takeover) stamps a WAL snapshot as a side effect,
+    so replay is additionally bounded by repair traffic however the
+    knob is set — the compact_off series here shows the shape those
+    mechanisms prevent, and tier_death measures the multi-member
+    reality."""
+    from ..elastic import replica as replica_mod
+
+    out: List[Dict[str, float]] = []
+    saved_ae = replica_mod._ANTI_ENTROPY_EVERY
+    replica_mod._ANTI_ENTROPY_EVERY = 1 << 30
+    try:
+        _measure_recovery_rows(lease_ms, lengths, out)
+    finally:
+        replica_mod._ANTI_ENTROPY_EVERY = saved_ae
+    return out
+
+
+def _measure_recovery_rows(lease_ms: float, lengths,
+                           out: List[Dict[str, float]]) -> None:
+    import os
+    import shutil
+    import tempfile
+
+    from ..elastic.replica import ReplicaTier
+    from ..peer import post_url, put_url
+    from ..retrying import NO_RETRY
+
+    for label, compact in (("compact_off", str(1 << 30)),
+                           ("compact_128", "128")):
+        for length in lengths:
+            d = tempfile.mkdtemp(prefix="kf-cp-wal-rec-")
+            saved = {k: os.environ.get(k)
+                     for k in ("KF_CP_WAL_COMPACT_OPS",)}
+            os.environ["KF_CP_WAL_COMPACT_OPS"] = compact
+            tier = None
+            try:
+                tier = ReplicaTier(n=1, lease_ms=lease_ms, wal_dir=d)
+                lead = tier.wait_leader()
+                put_url(lead.base + "/put", _mk_stage().to_json(),
+                        retry=NO_RETRY)
+                errs: List[BaseException] = []
+                bar = threading.Barrier(_ADMIT_THREADS + 1)
+                # add/remove PAIRS per thread: each thread's remove
+                # follows its own acked add, so the global worker
+                # count never dips below the seeded baseline
+                per = length // (_ADMIT_THREADS * 2)
+
+                def pump(k: int) -> None:
+                    try:
+                        bar.wait(10)
+                        for _ in range(per):
+                            post_url(lead.base + "/addworker", "{}",
+                                     retry=NO_RETRY)
+                            post_url(lead.base + "/removeworker",
+                                     "{}", retry=NO_RETRY)
+                    # kflint: disable=retry-discipline
+                    except BaseException as e:  # noqa: BLE001
+                        errs.append(e)
+
+                workers = [threading.Thread(target=pump, args=(k,),
+                                            daemon=True)
+                           for k in range(_ADMIT_THREADS)]
+                for t in workers:
+                    t.start()
+                _sync(bar, errs)
+                for t in workers:
+                    t.join()
+                if errs:
+                    raise errs[0]
+                seq_before = lead.seq
+                lead.crash()
+                t0 = time.perf_counter()
+                lead.reincarnate()
+                restart_ms = (time.perf_counter() - t0) * 1e3
+                if lead.seq < seq_before:
+                    raise RuntimeError(
+                        f"replay regressed: {lead.seq} < {seq_before}")
+                out.append({
+                    "series": label, "acked_ops": length,
+                    "replay_ms": round(lead.wal_replay_ms, 2),
+                    "restart_ms": round(restart_ms, 1),
+                })
+            finally:
+                if tier is not None:
+                    tier.stop()
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                shutil.rmtree(d, ignore_errors=True)
+
+
+def measure_tier_death(lease_ms: float) -> Dict[str, float]:
+    """Whole-tier death MTTR: every replica crashed at once under
+    live traffic, relaunched from WALs, decomposed replay (the max
+    per-replica WAL replay clock) -> election (the relaunched tier's
+    KF_CP_MTTR marks) -> catchup -> first served client write."""
+    import shutil
+    import tempfile
+
+    from ..elastic.replica import ReplicaTier
+
+    from ..peer import put_url
+    from ..retrying import NO_RETRY
+
+    d = tempfile.mkdtemp(prefix="kf-cp-wal-mttr-")
+    tier = ReplicaTier(n=3, lease_ms=lease_ms, wal_dir=d)
+    traffic = None
+    try:
+        lead = tier.wait_leader()
+        put_url(lead.base + "/put", _mk_stage().to_json(),
+                retry=NO_RETRY)
+        for r in tier.replicas:
+            r.serve_ledger.max_queue = 100_000
+        traffic = _Traffic(tier).start()
+        for r in tier.replicas:
+            r.mttr_marks.clear()
+        t_crash = time.time() * 1e3
+        tier.kill_all()
+        # the outage is the tier's to end: relaunch IS part of MTTR
+        tier.relaunch()
+        t_up = time.time() * 1e3
+        replay_ms = max(r.wal_replay_ms for r in tier.replicas)
+        new_lead, deadline = None, time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            cur = tier.leader()
+            if cur is not None and "catchup_done" in cur.mttr_marks:
+                new_lead = cur
+                break
+            time.sleep(0.005)
+        if new_lead is None:
+            raise TimeoutError(
+                f"tier never re-elected: "
+                f"{[r.status() for r in tier.replicas]}")
+        marks = dict(new_lead.mttr_marks)
+        t_first = traffic.first_served_after(t_crash)
+        traffic.stop()
+        traffic = None
+        return {
+            "relaunch_ms": round(t_up - t_crash, 1),
+            "replay_ms": round(replay_ms, 2),
+            "election_ms": round(marks["elected"] - t_up, 1),
+            "catchup_ms": round(
+                marks["catchup_done"] - marks["elected"], 1),
+            "first_request_ms": round(
+                max(0.0, t_first - marks["catchup_done"]), 1),
+            "mttr_ms": round(t_first - t_crash, 1),
+        }
+    finally:
+        if traffic is not None:
+            traffic._stop.set()
+            traffic._t.join(timeout=5.0)
+        tier.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _median_rows(runs: List[Dict[str, float]]) -> Dict[str, float]:
     return {k: round(statistics.median(r[k] for r in runs), 1)
             for k in runs[0] if isinstance(runs[0][k], (int, float))}
@@ -560,6 +763,36 @@ def main(argv=None) -> int:
           f"(group-commit speedup {group_commit_speedup}x)",
           flush=True)
 
+    # durability rows (docs/control_plane.md "Durability"): the same
+    # n=3 burst with every replica writing its WAL — fsync on vs off
+    durability = measure_durability(args.lease_ms, args.ops,
+                                    args.submits)
+    fsync_cost = (
+        round(cost["3"]["admissions_per_s"]
+              / durability["fsync_on"]["admissions_per_s"], 2)
+        if durability["fsync_on"]["admissions_per_s"] else None)
+    print(f"replicas=3 + WAL: fsync_on "
+          f"{durability['fsync_on']['admissions_per_s']} admissions/s"
+          f", fsync_off "
+          f"{durability['fsync_off']['admissions_per_s']} admissions/s"
+          f" (memory-only/fsync_on = {fsync_cost}x)", flush=True)
+    recovery = measure_recovery(args.lease_ms)
+    for row in recovery:
+        print(f"recovery {row['series']} acked_ops="
+              f"{row['acked_ops']}: replay {row['replay_ms']} ms, "
+              f"restart {row['restart_ms']} ms", flush=True)
+    tier_death_runs = []
+    for i in range(args.runs):
+        d = measure_tier_death(args.lease_ms)
+        tier_death_runs.append(d)
+        print(f"tier_death run {i + 1}/{args.runs}: "
+              f"mttr={d['mttr_ms']:.0f} ms (relaunch+replay "
+              f"{d['relaunch_ms']:.0f} [replay {d['replay_ms']}] + "
+              f"election {d['election_ms']:.0f} + catchup "
+              f"{d['catchup_ms']:.0f} + first_request "
+              f"{d['first_request_ms']:.0f})", flush=True)
+    tier_death = _median_rows(tier_death_runs)
+
     router: Dict[str, Dict[str, float]] = {}
     for nr in (1, 2):
         router[str(nr)] = measure_router(nr, args.lease_ms,
@@ -605,6 +838,10 @@ def main(argv=None) -> int:
         "router": router,
         "router_chaos": router_chaos,
         "router_scaling": router_scaling,
+        "durability": durability,
+        "fsync_cost": fsync_cost,
+        "recovery": recovery,
+        "tier_death": tier_death,
         "note": (
             "in-process 3-replica tier on loopback, 1-core container "
             "— absolute latencies include core contention and the "
@@ -619,7 +856,15 @@ def main(argv=None) -> int:
             "commit only amortizes overlapping writes. Router rows "
             "drive the burst through the stateless front door "
             "(serve/router.py); the chaos row kills router 0 "
-            "mid-burst and gates on zero dropped requests"
+            "mid-burst and gates on zero dropped requests. "
+            "Durability rows re-run the n=3 burst with per-replica "
+            "WALs (elastic/wal.py): fsync_on vs KF_CP_FSYNC=0 prices "
+            "the sync itself, the memory-only row the whole log; "
+            "recovery rows crash+relaunch a follower at each WAL "
+            "length (KF_CP_WAL_COMPACT_OPS=128 is what keeps replay "
+            "flat); tier_death kills ALL replicas mid-traffic and "
+            "decomposes relaunch+replay -> election -> catchup -> "
+            "first served write, with zero acked writes lost"
         ),
     }
     if args.json:
@@ -684,6 +929,38 @@ def main(argv=None) -> int:
                         no_batch["admissions_per_s"],
                     "group_commit_speedup": group_commit_speedup,
                     "source": source,
+                    "caveat": "1-core loopback; see BASELINE.md",
+                },
+            },
+            cmd=("python -m kungfu_tpu.benchmarks.control_plane "
+                 "--publish"))
+        publish_result(
+            "control_plane_durability",
+            {"benchmark": "control_plane_durability",
+             "lease_ms": args.lease_ms,
+             "durability": durability, "fsync_cost": fsync_cost,
+             "recovery": recovery, "tier_death": tier_death,
+             "note": result["note"]},
+            parsed={
+                "metric": "cp_wal_fsync_admissions_per_s",
+                "value": durability["fsync_on"]["admissions_per_s"],
+                "unit": ("admissions/s into a 3-replica tier with "
+                         "every replica fsyncing its WAL once per "
+                         "group-commit window, 8-way concurrent "
+                         "burst"),
+                "details": {
+                    "fsync_off_admissions_per_s":
+                        durability["fsync_off"]["admissions_per_s"],
+                    "memory_only_admissions_per_s":
+                        cost["3"]["admissions_per_s"],
+                    "fsync_cost_x": fsync_cost,
+                    "recovery": recovery,
+                    "tier_death_mttr_ms": tier_death["mttr_ms"],
+                    "tier_death_decomposition": {
+                        k: tier_death[k]
+                        for k in ("relaunch_ms", "replay_ms",
+                                  "election_ms", "catchup_ms",
+                                  "first_request_ms")},
                     "caveat": "1-core loopback; see BASELINE.md",
                 },
             },

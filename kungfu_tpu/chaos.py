@@ -12,6 +12,8 @@ uses), and is consulted at fixed hook points in the runtime:
 - ``on_http_request(path)``      — elastic/config_server handlers
 - ``on_replica_request(path, replica, role)``
                                  — elastic/replica.py handlers
+- ``on_wal_append(replica, append_idx)``
+                                 — elastic/replica.py WAL appends
 - ``on_control_send(name)``      — ffi.NativePeer.send_control
 - ``on_spawn(rank)``             — run/job.spawn_worker
 
@@ -31,6 +33,9 @@ Schedule format (``KF_CHAOS`` inline JSON, or ``KF_CHAOS_FILE`` path)::
         {"type": "die_config_server", "after_requests": 10},
         {"type": "kill_config_replica", "role": "leader",
          "path": "/addworker"},
+        {"type": "restart_config_replica", "role": "follower",
+         "replica": 2, "after_requests": 20},
+        {"type": "wal_enospc", "replica": 0, "after_appends": 5},
         {"type": "kill_router", "router": 0, "after_requests": 20},
         {"type": "drop_control", "name": "update", "count": 1},
         {"type": "delay_control", "name": "update", "ms": 100, "count": 2},
@@ -91,6 +96,8 @@ _KNOWN_TYPES = {
     "delay_http",
     "die_config_server",
     "kill_config_replica",
+    "restart_config_replica",
+    "wal_enospc",
     "kill_router",
     "drop_control",
     "delay_control",
@@ -341,7 +348,10 @@ def on_replica_request(path: str, replica: int, role: str
     """elastic/replica.py handler hook: the single-server actions plus
     ``kill_config_replica`` — PERMANENT death (``{"kill": True}``; the
     victim never restarts), distinct from the restart-shaped
-    ``die_config_server``. Matched on the replica index and its role
+    ``die_config_server`` — and ``restart_config_replica`` — crash +
+    relaunch-from-WAL (``{"restart": True}``: the victim loses all
+    memory, replays its write-ahead log, rejoins ``behind`` and is
+    repaired by the tier). Matched on the replica index and its role
     AT REQUEST TIME (``role: "leader"`` kills whoever currently holds
     the lease — the coordinate of interest for takeover tests, since
     election order decides which index that is). ONE request-index
@@ -359,7 +369,37 @@ def on_replica_request(path: str, replica: int, role: str
         _fire("kill_config_replica", path=path, replica=replica,
               role=role, request=idx)
         return {"kill": True}
+    f = sched.take(
+        "restart_config_replica", path=path, replica=replica,
+        role=role,
+        _when=lambda f: idx >= int(f.spec.get("after_requests", 0)))
+    if f is not None:
+        _fire("restart_config_replica", path=path, replica=replica,
+              role=role, request=idx)
+        return {"restart": True}
     return _http_action(sched, idx, path)
+
+
+def on_wal_append(replica: int, append_idx: int) -> Optional[Dict]:
+    """elastic/replica.py WAL-append hook: ``wal_enospc`` — the disk
+    fills exactly at the ``after_appends``-th record of one replica's
+    write-ahead log (``{"enospc": True}``; the replica raises a real
+    ``OSError(ENOSPC)`` and must FAIL FAST, never ack an unpersisted
+    write). Matched against the WAL's OWN record counter (passed in as
+    ``append_idx``) — append cadence is commit-window-dependent, so it
+    must not advance the shared HTTP request index that
+    ``after_requests`` schedules are pinned to."""
+    sched = active()
+    if sched is None:
+        return None
+    f = sched.take(
+        "wal_enospc", replica=replica,
+        _when=lambda f: append_idx >= int(
+            f.spec.get("after_appends", 0)))
+    if f is not None:
+        _fire("wal_enospc", replica=replica, append=append_idx)
+        return {"enospc": True}
+    return None
 
 
 def on_router_request(path: str, router: int,
@@ -532,6 +572,72 @@ def corrupt_sharded_generation(gen_dir: str, mode: str,
         with open(path, "w") as f:
             json.dump(piece, f)
         _fire("mismatch_manifest", path=path, seed=seed)
+    return path
+
+
+#: the two ways a control-plane WAL directory (elastic/wal.py layout)
+#: can rot on disk; each must be DETECTED at replay — torn_tail
+#: truncates loudly at the last good checksum, stale_snapshot refuses
+#: the log and rejoins `behind` for peer repair — never replayed as
+#: silently regressed state (tests/test_control_plane.py holds it)
+WAL_CORRUPTIONS = ("torn_tail", "stale_snapshot")
+
+
+def corrupt_wal(wal_dir: str, mode: str,
+                seed: Optional[int] = None) -> str:
+    """Deterministically damage one replica's write-ahead log.
+
+    ``torn_tail`` cuts ``wal.log`` mid-record at a schedule-seeded
+    offset strictly inside the LAST record (the power-loss-mid-append
+    shape: earlier records stay valid, the tail fails its checksum);
+    ``stale_snapshot`` rewrites the snapshot's seq stamp to a seeded
+    smaller value (an old file swapped back in: the log's first op no
+    longer meets the stamp, so replaying the hybrid would silently
+    regress state). The cut point and the regressed stamp derive from
+    the seed alone, so a failing chaos test replays byte-identically.
+    Returns the damaged path."""
+    from .elastic import wal as wal_mod
+
+    if mode not in WAL_CORRUPTIONS:
+        raise ValueError(f"unknown WAL corruption {mode!r} "
+                         f"(known: {WAL_CORRUPTIONS})")
+    if seed is None:
+        sched = active()
+        seed = sched.seed if sched is not None else 0
+    rng = random.Random(seed)
+    if mode == "torn_tail":
+        path = os.path.join(wal_dir, wal_mod.LOG_FILE)
+        # find the last record's start by walking the length prefixes
+        with open(path, "rb") as f:
+            data = f.read()
+        hdr = wal_mod._HEADER
+        off = last = 0
+        while off + hdr <= len(data):
+            (length,) = wal_mod._LEN.unpack_from(data, off)
+            if off + hdr + length > len(data):
+                break
+            last = off
+            off += hdr + length
+        if off == 0:
+            raise FileNotFoundError(f"no records to tear in {path}")
+        # cut strictly inside the last record: keep at least one byte
+        # of it (so there IS a torn tail) and drop at least one
+        keep = last + 1 + rng.randrange(off - last - 1)
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+        _fire("torn_tail", path=path, kept=keep, seed=seed)
+    else:
+        path = os.path.join(wal_dir, wal_mod.SNAP_FILE)
+        with open(path) as f:
+            snap = json.load(f)
+        seq = int(snap.get("seq", 0))
+        if seq <= 0:
+            raise ValueError(f"snapshot {path} has no seq to regress")
+        snap["seq"] = rng.randrange(seq)  # strictly older stamp
+        with open(path, "w") as f:
+            json.dump(snap, f)
+        _fire("stale_snapshot", path=path, old_seq=seq,
+              new_seq=snap["seq"], seed=seed)
     return path
 
 

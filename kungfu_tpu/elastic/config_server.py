@@ -234,6 +234,12 @@ class ConfigServer:
         treats it like a crash; the replica subclass never comes back."""
         self._chaos_die()
 
+    def _chaos_restart(self) -> None:
+        """Crash-restart (restart_config_replica) — the base tier-of-one
+        treats it like the restart-shaped crash; the replica subclass
+        relaunches itself from its write-ahead log."""
+        self._chaos_die()
+
     def state_snapshot(self) -> dict:
         """The full replicated state machine: membership stage (+ the
         seeded initial for /reset), request ledger, trace store."""
@@ -330,9 +336,12 @@ class ConfigServer:
                 action = server._chaos_hook(self.path)
                 if not action:
                     return False
-                if action.get("die") or action.get("kill"):
+                if action.get("die") or action.get("kill") or \
+                        action.get("restart"):
                     if action.get("kill"):
                         server._chaos_kill()  # permanent: no restart
+                    elif action.get("restart"):
+                        server._chaos_restart()  # crash + WAL relaunch
                     else:
                         server._chaos_die()
                     # drop the connection WITHOUT a reply: the client

@@ -54,23 +54,42 @@ deadlines) may drift by the replay delay between replicas; takeover
 re-bases them (`renew_leases`) and a periodic anti-entropy full push
 (every ``_ANTI_ENTROPY_EVERY`` batches) bounds any residual drift.
 
+**Durability (elastic/wal.py, docs/control_plane.md "Durability"):**
+when a WAL directory is configured (``KF_CP_WAL_DIR`` or the
+``wal_dir`` argument), every replica persists its slice of the
+protocol — the leader fsyncs each group-commit batch ONCE before
+acking it (durability rides the KF_CP_COMMIT_MS batching, no per-op
+sync), followers append the batches they replay and the snapshots
+they adopt, ``(term, voted_term)`` is persisted BEFORE any vote is
+granted or candidacy swept, and a periodic snapshot compaction
+(``KF_CP_WAL_COMPACT_OPS``) bounds replay length. A restarted replica
+replays snapshot + log, rejoins ``behind`` and is caught up through
+the existing delta/snapshot repair path; a whole tier relaunched from
+its WALs loses no acked write. ``KF_CP_FSYNC=0`` keeps the log but
+skips the sync (the benchmark ablation). A replica that cannot append
+(ENOSPC/EROFS) dies loudly rather than ack unpersisted writes.
+
 **What this is NOT (Raft honesty, expanded in docs/control_plane.md
 and PAPERS.md):** election counts a majority of replicas that
 *responded*, not of the configured membership — under a symmetric
 partition two leaders can coexist (split brain), which real Raft's
-fixed-quorum rule forbids. There is no persistent term/vote state
-(a full-tier restart forgets its history) and no log-completeness
-voting restriction (a follower that missed the last push can win and
-serve slightly-stale state; the stage's version-must-grow rule then
-rejects stale *writes*, so divergence is bounded to read staleness,
-never version regression). This buys leader failover for the
-single-writer, idempotent-snapshot state machine the repo actually
-has, at ~300 lines instead of a consensus library.
+fixed-quorum rule forbids. Candidates carry their ``(seq_term, seq)``
+log position and a voter refuses a candidate behind itself (the
+§5.4.1 completeness restriction), but "committed" still means "acked
+by the push to every REACHABLE follower": a write acked while a
+follower was unreachable lives only on the leader's WAL, and a
+whole-tier restart that loses exactly that disk loses the write —
+real Raft's majority-ack rule is what buys more. Divergence beyond
+that is bounded to read staleness by the stage's version-must-grow
+rule, never version regression. This buys durable leader failover
+for the single-writer, idempotent-snapshot state machine the repo
+actually has, at ~400 lines instead of a consensus library.
 """
 
 from __future__ import annotations
 
 import argparse
+import errno
 import json
 import os
 import random
@@ -81,8 +100,9 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from .. import chaos
-from ..env import env_float
+from ..env import env_flag, env_float, env_int
 from .config_server import ConfigServer
+from .wal import WriteAheadLog
 
 #: routes a follower redirects to the leader — everything that mutates
 #: replicated state. /stop and /replica/* are replica-local by design.
@@ -150,7 +170,8 @@ class ReplicaConfigServer(ConfigServer):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  standalone: bool = False, index: int = 0,
-                 lease_ms: Optional[float] = None):
+                 lease_ms: Optional[float] = None,
+                 wal_dir: Optional[str] = None):
         super().__init__(host, port, standalone)
         self.index = int(index)
         self.lease_ms = float(lease_ms) if lease_ms is not None else \
@@ -186,6 +207,23 @@ class ReplicaConfigServer(ConfigServer):
         self._log: List[Dict] = []  # kf: guarded_by(_log_cv)
         self._committer: Optional[threading.Thread] = None
         self.delta_batches = 0  # committed batches (stats/anti-entropy)
+        # -- durable spine (elastic/wal.py): enabled iff a WAL dir is
+        # configured; memory-only tiers (the pre-WAL default) stay
+        # byte-identical in behavior
+        root = wal_dir if wal_dir is not None \
+            else os.environ.get("KF_CP_WAL_DIR", "")
+        self._wal_root = root
+        self.wal: Optional[WriteAheadLog] = None
+        if root:
+            self.wal = WriteAheadLog(
+                os.path.join(root, f"replica-{self.index}"),
+                fsync=env_flag("KF_CP_FSYNC", True),
+                name=f"r{self.index}")
+        self.wal_compact_ops = env_int("KF_CP_WAL_COMPACT_OPS", 512,
+                                       minimum=8)
+        self.wal_replay_ms = 0.0
+        if self.wal is not None:
+            self._recover_from_wal()
 
     # -- identity -----------------------------------------------------------
 
@@ -200,7 +238,9 @@ class ReplicaConfigServer(ConfigServer):
                     "leader": self.leader_base,
                     "index": self.index, "base": self.base,
                     "dead": self.dead,
-                    "delta_batches": self.delta_batches}
+                    "delta_batches": self.delta_batches,
+                    "wal": self.wal is not None,
+                    "wal_replay_ms": round(self.wal_replay_ms, 1)}
 
     # -- wiring -------------------------------------------------------------
 
@@ -232,6 +272,154 @@ class ReplicaConfigServer(ConfigServer):
         with self._log_cv:
             self._log_cv.notify_all()  # wake the committer to drain
         threading.Thread(target=self.stop, daemon=True).start()
+
+    def crash(self) -> None:
+        """Abrupt SYNCHRONOUS stop — the in-process SIGKILL analog for
+        whole-tier-death tests: no drain, no detached stop thread (a
+        lingering one could race a later relaunch and kill the new
+        listener). Unlike ``die()`` this is restartable: a subsequent
+        ``reincarnate()`` replays the WAL and rejoins."""
+        self.dead = True
+        with self._rlock:
+            self.role = "dead"
+        self._stop_monitor.set()
+        with self._log_cv:
+            self._log_cv.notify_all()
+        self.stop()
+
+    def reincarnate(self) -> "ReplicaConfigServer":
+        """Crash-restart in place — the in-process analog of SIGKILL +
+        relaunch (the ``restart_config_replica`` chaos contract,
+        distinct from the permanent ``die()``): drop ALL in-memory
+        state, replay the WAL, rebind the same port and rejoin as a
+        follower. The recovered seq answers ``behind``/``gap`` and the
+        existing snapshot repair path catches us up without disturbing
+        live traffic."""
+        if self.wal is None:
+            raise RuntimeError(
+                f"replica {self.index}: reincarnate needs a WAL "
+                "(a memory-only replica can only restart() with its "
+                "state intact)")
+        # crash: stop serving, retire the monitor + committer threads
+        self._stop_monitor.set()
+        with self._log_cv:
+            self._log_cv.notify_all()
+        self.stop()
+        for t in (self._monitor, self._committer):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=5.0)
+        self.wal.close()
+        # amnesia: fresh state containers. Harness-configured ledger
+        # knobs carry over the way env vars would for a relaunched
+        # process (tests set max_queue/lease_ms on the object).
+        from ..serve.ledger import RequestLedger
+        from ..trace.collect import TraceStore
+
+        old_ledger = self.serve_ledger
+        self.serve_ledger = RequestLedger(
+            max_queue=old_ledger.max_queue,
+            lease_ms=old_ledger.lease_ms)
+        self.trace_store = TraceStore()
+        with self._lock:
+            self._stage = None
+            self._initial = None
+        with self._rlock:
+            self.term = 0
+            self.voted_term = 0
+            self.role = "follower"
+            self.leader_base = ""
+            self.seq = 0
+            self.seq_term = 0
+            self._hb_t = time.monotonic()
+        self.mttr_marks = {}
+        self.delta_batches = 0
+        with self._log_cv:
+            self._log = []
+        self.dead = False
+        # relaunch: fresh WAL handle, replay, rebind, fresh threads
+        # (the retired ones saw the OLD stop event and exited)
+        self.wal = WriteAheadLog(self.wal.dir, fsync=self.wal.fsync,
+                                 name=f"r{self.index}")
+        self._recover_from_wal()
+        self._stop_monitor = threading.Event()
+        self.restart()  # same-port rebind with retry
+        if self.peers:
+            self.wire(list(self.peers))
+        return self
+
+    # -- durability: write-ahead log (elastic/wal.py) -----------------------
+
+    def _recover_from_wal(self) -> None:
+        """Crash-restart path: adopt the persisted election state,
+        restore the compaction snapshot, replay the ops since it. The
+        recovered (seq, seq_term) is whatever the disk proves — the
+        next heartbeat reads it as ``behind`` if the tier moved on,
+        and the existing snapshot repair path catches us up."""
+        rep = self.wal.replay()
+        with self._rlock:
+            self.term = max(self.term, rep.term)
+            self.voted_term = max(self.voted_term, rep.voted_term)
+        if rep.snapshot is not None:
+            self.state_restore(rep.snapshot["state"])
+        for o in rep.ops:
+            self._apply_op(str(o.get("kind", "")), o.get("op") or {})
+        with self._rlock:
+            self.seq = rep.seq
+            self.seq_term = rep.seq_term
+        self.wal_replay_ms = rep.replay_ms
+        print(f"KF_CP_WAL_REPLAY replica={self.index} seq={rep.seq} "
+              f"seq_term={rep.seq_term} term={rep.term} "
+              f"ops={len(rep.ops)} torn_bytes={rep.torn_bytes} "
+              f"stale_snapshot={int(rep.stale_snapshot)} "
+              f"ms={rep.replay_ms:.1f}", flush=True)
+
+    def _wal_save_term(self) -> None:
+        """Persist ``(term, voted_term)``. Callers invoke this BEFORE
+        acting on the new value (granting the vote, sweeping the
+        candidacy) — election safety across restarts needs the durable
+        write first. Reading under the lock again can only persist a
+        value >= the one acted on, which is safe."""
+        if self.wal is None:
+            return
+        with self._rlock:
+            term, voted = self.term, self.voted_term
+        self.wal.save_term(term, voted)
+
+    def _wal_append(self, term: int, ops: List[Dict]) -> None:
+        """Append one committed batch — ONE record, ONE fsync. Chaos
+        can inject ENOSPC here; real or injected, the OSError
+        propagates and the caller fails fast."""
+        if self.wal is None:
+            return
+        act = chaos.on_wal_append(self.index,
+                                  self.wal.records_appended)
+        if act and act.get("enospc"):
+            raise OSError(errno.ENOSPC,
+                          "chaos: injected ENOSPC on WAL append")
+        self.wal.append_batch(term, ops)
+
+    def _wal_die(self, what: str, e: BaseException) -> None:
+        """A replica that cannot persist must not serve: die loudly
+        rather than ack writes the disk did not take."""
+        print(f"KF_WAL_FAIL replica={self.index} during={what} "
+              f"errno={getattr(e, 'errno', None)}: {e}", flush=True)
+        if self.standalone:
+            os._exit(25)
+        self.die()
+
+    def _wal_maybe_compact(self) -> None:
+        """Snapshot compaction: once KF_CP_WAL_COMPACT_OPS ops piled
+        up since the last snapshot, persist the full state stamped at
+        the exact current (seq_term, seq) — under ``_mut_mu`` so the
+        stamp is exact (op replay is not idempotent) — and truncate
+        the log. Replay time stays flat in total history length."""
+        if self.wal is None or \
+                self.wal.ops_since_snapshot < self.wal_compact_ops:
+            return
+        with self._mut_mu:
+            with self._rlock:
+                term, seq = self.seq_term, self.seq
+            self.wal.save_snapshot(term, seq, self.state_snapshot())
 
     # -- monitor: heartbeats out (leader) / lease watch (follower) ----------
 
@@ -266,6 +454,14 @@ class ReplicaConfigServer(ConfigServer):
             self.voted_term = max(self.voted_term, term)  # vote for self
             self._hb_t = time.monotonic()  # restart the clock either way
             peers = list(self.peers)
+            seq, seq_term = self.seq, self.seq_term
+        try:
+            # durable BEFORE the sweep: a candidacy we could forget
+            # across a restart could re-vote differently at this term
+            self._wal_save_term()
+        except OSError as e:
+            self._wal_die("save_term", e)
+            return
         # detect == first candidacy after the lease lapsed (takeover
         # MTTR phase 1); setdefault keeps the FIRST detection if the
         # election needs several rounds
@@ -283,7 +479,9 @@ class ReplicaConfigServer(ConfigServer):
             try:
                 out = _rpc(peer_base, "/replica/vote",
                            {"term": term, "candidate": self.index,
-                            "base": self.base},
+                            "base": self.base,
+                            # log position for the completeness check
+                            "seq": seq, "seq_term": seq_term},
                            timeout=max(0.5, self.lease_ms / 2e3))
             except _RPCReject:
                 reachable += 1  # answered (a no is still a voter)
@@ -423,6 +621,15 @@ class ReplicaConfigServer(ConfigServer):
         payload = {"term": term, "leader": self.base,
                    "ops": [{"seq": e["seq"], "kind": e["kind"],
                             "op": e["op"]} for e in batch]}
+        try:
+            # log-then-replicate: the batch is on OUR disk before any
+            # follower sees it, and ONE fsync covers the whole commit
+            # window — an acked write survives whole-tier death
+            self._wal_append(term, payload["ops"])
+        except OSError as e:
+            self._fail(batch)
+            self._wal_die("append", e)
+            return
         fenced = 0
         for i, peer_base in enumerate(peers):
             if i == self.index:
@@ -450,6 +657,7 @@ class ReplicaConfigServer(ConfigServer):
         for entry in batch:
             entry["ok"] = True
             entry["ev"].set()
+        self._wal_maybe_compact()
         if self.delta_batches % _ANTI_ENTROPY_EVERY == 0:
             self._push_state()  # bound clock-replay drift (docstring)
 
@@ -491,6 +699,16 @@ class ReplicaConfigServer(ConfigServer):
                 peers = list(self.peers)
             payload = {"term": term, "seq": seq, "leader": self.base,
                        "state": self.state_snapshot()}
+            if self.wal is not None:
+                # the bump consumed a seq with no log record: persist
+                # the snapshot at the bumped stamp or our own replay
+                # would see a gap (doubles as leader-side compaction)
+                try:
+                    self.wal.save_snapshot(term, seq,
+                                           payload["state"])
+                except OSError as e:
+                    self._wal_die("snapshot", e)
+                    return
         fenced = 0
         for i, peer_base in enumerate(peers):
             if i == self.index:
@@ -596,6 +814,16 @@ class ReplicaConfigServer(ConfigServer):
         req_term = int(msg.get("term", 0))
         with self._rlock:
             granted = req_term > max(self.term, self.voted_term)
+            if granted and "seq" in msg:
+                # log-completeness restriction (Raft §5.4.1): refuse a
+                # candidate whose durable log position is behind ours —
+                # after a whole-tier restart the most complete replayed
+                # WAL must win, or acked writes replay out of history.
+                # (Legacy vote requests without a position skip this.)
+                mine = (self.seq_term, self.seq)
+                theirs = (int(msg.get("seq_term", 0)),
+                          int(msg.get("seq", 0)))
+                granted = theirs >= mine
             if granted:
                 self.voted_term = req_term
                 self._hb_t = time.monotonic()  # give the candidate room
@@ -604,8 +832,18 @@ class ReplicaConfigServer(ConfigServer):
                     # term win rather than split the tier
                     self.role = "follower"
                     self.leader_base = ""
+            changed = req_term > self.term or granted
             self.term = max(self.term, req_term)
             term = self.term
+        if changed:
+            try:
+                # the grant (and the adopted term) must be durable
+                # BEFORE the candidate hears it: a restarted voter
+                # that forgot its vote could grant twice in one term
+                self._wal_save_term()
+            except OSError as e:
+                self._wal_die("save_vote", e)
+                return (503, json.dumps({"error": "wal append failed"}))
         return (200, json.dumps({"granted": granted, "term": term}))
 
     def _on_apply(self, msg: Dict):
@@ -636,6 +874,16 @@ class ReplicaConfigServer(ConfigServer):
                 self.seq = req_seq
                 self.seq_term = req_term
             self.state_restore(msg["state"])
+            if self.wal is not None:
+                # an adopted snapshot supersedes our whole log: persist
+                # it at the leader's exact stamp and compact
+                try:
+                    self.wal.save_snapshot(req_term, req_seq,
+                                           msg["state"])
+                except OSError as e:
+                    self._wal_die("snapshot", e)
+                    return (503, json.dumps(
+                        {"error": "wal write failed"}))
         return (200, json.dumps({"ok": True, "seq": req_seq}))
 
     def _on_apply_delta(self, msg: Dict):
@@ -682,6 +930,14 @@ class ReplicaConfigServer(ConfigServer):
             for o in run:  # outside _rlock: ops take their own locks
                 self._apply_op(str(o.get("kind", "")),
                                o.get("op") or {})
+            try:
+                # the replayed batch is durable on OUR disk before we
+                # answer ok — any replica can restart from its WAL
+                self._wal_append(req_term, run)
+            except OSError as e:
+                self._wal_die("append", e)
+                return (503, json.dumps({"error": "wal write failed"}))
+        self._wal_maybe_compact()
         if gap:
             return (200, json.dumps({"gap": True, "seq": seq}))
         return (200, json.dumps({"ok": True, "seq": seq}))
@@ -758,6 +1014,21 @@ class ReplicaConfigServer(ConfigServer):
         if self.standalone:
             os._exit(23)  # abrupt AND permanent: nobody restarts us
         self.die()
+
+    def _chaos_restart(self) -> None:
+        """The ``restart_config_replica`` fault: crash NOW, relaunch
+        from the WAL. Standalone the process exits abruptly (exit 24)
+        and its supervisor respawns it with the same --wal-dir; in
+        process we reincarnate on a detached thread (the handler
+        thread must not stop its own server)."""
+        if self.standalone:
+            os._exit(24)
+        if self.wal is None:
+            self.die()  # no disk to come back from: a plain crash
+            return
+        threading.Thread(target=self.reincarnate, daemon=True,
+                         name=f"kf-replica-restart-{self.index}"
+                         ).start()
 
 
 class _TierLedgerClient:
@@ -852,15 +1123,56 @@ class ReplicaTier:
     real decode cluster against it unchanged."""
 
     def __init__(self, n: int = 3, lease_ms: float = 500.0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 wal_dir: Optional[str] = None,
+                 ports: Optional[List[int]] = None):
+        self.host = host
+        self.lease_ms = lease_ms
+        self.wal_dir = wal_dir
         self.replicas = [
-            ReplicaConfigServer(host=host, index=i,
-                                lease_ms=lease_ms).start()
+            self._launch(i, 0 if ports is None else int(ports[i]))
             for i in range(n)
         ]
         self.bases = [r.base for r in self.replicas]
         for r in self.replicas:
             r.wire(self.bases)
+
+    def _launch(self, i: int, port: int) -> ReplicaConfigServer:
+        r = ReplicaConfigServer(host=self.host, port=port, index=i,
+                                lease_ms=self.lease_ms,
+                                wal_dir=self.wal_dir)
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                return r.start()
+            except OSError:
+                # pinned-port relaunch (whole-tier recovery): the dead
+                # incarnation's listener can take a beat to release
+                if port == 0 or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    @property
+    def ports(self) -> List[int]:
+        return [r.port for r in self.replicas]
+
+    def kill_all(self) -> None:
+        """Whole-tier death: crash every replica at once, no drain —
+        the all-replicas-SIGKILLed shape. Restartable via relaunch()
+        when the tier has a WAL dir."""
+        for r in self.replicas:
+            r.crash()
+
+    def relaunch(self) -> "ReplicaTier":
+        """Bring the WHOLE tier back from its WALs on the SAME ports,
+        in place — harnesses holding this object (and clients holding
+        KF_CONFIG_SERVERS) keep working across the outage."""
+        if not self.wal_dir:
+            raise RuntimeError(
+                "relaunch needs a tier constructed with wal_dir")
+        for r in self.replicas:
+            r.reincarnate()
+        return self
 
     def env(self) -> Dict[str, str]:
         """The client-side failover config (KF_CONFIG_SERVERS)."""
@@ -949,6 +1261,8 @@ class ReplicaTier:
             r._stop_monitor.set()
         for r in self.replicas:
             r.stop()
+            if r.wal is not None:
+                r.wal.close()
 
 
 def main(argv=None):
@@ -961,10 +1275,15 @@ def main(argv=None):
                     help="comma-separated base URLs, index-aligned "
                          "(this replica's own base included)")
     ap.add_argument("--lease-ms", type=float, default=None)
+    ap.add_argument("--wal-dir", default=None,
+                    help="tier WAL root (this replica persists under "
+                         "<wal-dir>/replica-<index>; a relaunch with "
+                         "the same flag replays it). Defaults to "
+                         "KF_CP_WAL_DIR; empty = memory-only")
     args = ap.parse_args(argv)
     server = ReplicaConfigServer(
         args.host, args.port, standalone=True, index=args.index,
-        lease_ms=args.lease_ms).start()
+        lease_ms=args.lease_ms, wal_dir=args.wal_dir).start()
     server.wire([b.strip().rstrip("/") for b in args.peers.split(",")])
     print(f"[kf-replica] r{args.index} serving on {server.base}",
           flush=True)

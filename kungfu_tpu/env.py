@@ -39,6 +39,15 @@ CONFIG_VARS = (
     # fail over across (same base-URL shape as KF_CONFIG_SERVERS);
     # KF_ROUTER_FLUSH_MS is the router's submit-coalescing window
     "KF_CP_COMMIT_MS",
+    # control-plane durability (docs/control_plane.md "Durability"):
+    # KF_CP_WAL_DIR roots the per-replica write-ahead logs (empty =
+    # memory-only, the pre-WAL behavior); KF_CP_FSYNC=0 trades the
+    # one-fsync-per-commit-window durability for speed (benchmarked
+    # in benchmarks/control_plane.py); KF_CP_WAL_COMPACT_OPS is the
+    # snapshot-compaction trigger bounding replay length
+    "KF_CP_WAL_DIR",
+    "KF_CP_FSYNC",
+    "KF_CP_WAL_COMPACT_OPS",
     "KF_SERVE_ROUTERS",
     "KF_ROUTER_FLUSH_MS",
     "KF_LOG_LEVEL",
@@ -311,6 +320,8 @@ def from_env(environ: Optional[Dict[str, str]] = None) -> Config:
     env_server_list(CONFIG_SERVERS, e)
     env_float("KF_CONFIG_LEASE_MS", 2000.0, e, minimum=100.0)
     env_float("KF_CP_COMMIT_MS", 2.0, e, minimum=0.0)
+    env_flag("KF_CP_FSYNC", True, e)
+    env_int("KF_CP_WAL_COMPACT_OPS", 512, e, minimum=8)
     env_server_list("KF_SERVE_ROUTERS", e)
     env_float("KF_ROUTER_FLUSH_MS", 2.0, e, minimum=0.0)
     self_spec = e.get(SELF_SPEC, "")

@@ -23,6 +23,7 @@ sleeps; this module is the single Python-side equivalent.
 
 from __future__ import annotations
 
+import errno
 import random
 import time
 import urllib.error
@@ -36,9 +37,31 @@ T = TypeVar("T")
 # callers poll exactly that window.
 _TRANSIENT_HTTP = {404, 408, 429, 500, 502, 503, 504}
 
+# OSError shapes that no amount of waiting heals within a retry budget:
+# a full disk (ENOSPC), a read-only remount (EROFS — the kernel's
+# response to a dying device), a blown quota (EDQUOT). Retrying these
+# burns the whole deadline and then fails with a misleading timeout; the
+# caller (e.g. the WAL's fail-fast path) needs the real errno NOW.
+_PERMANENT_ERRNO = frozenset(
+    e for e in (errno.ENOSPC, errno.EROFS,
+                getattr(errno, "EDQUOT", None)) if e is not None)
+
+
+def _permanent_os_error(exc: BaseException) -> bool:
+    # URLError wraps its cause in .reason; unwrap one level so a socket
+    # layer that surfaces ENOSPC (e.g. a unix socket on a full tmpfs)
+    # classifies the same as the bare OSError.
+    if isinstance(exc, urllib.error.URLError) and \
+            isinstance(exc.reason, OSError):
+        exc = exc.reason
+    return (isinstance(exc, OSError)
+            and getattr(exc, "errno", None) in _PERMANENT_ERRNO)
+
 
 def is_transient(exc: BaseException) -> bool:
     """True when retrying the operation can plausibly succeed."""
+    if _permanent_os_error(exc):
+        return False  # full/read-only disk: waiting cannot heal it
     if isinstance(exc, urllib.error.HTTPError):
         return exc.code in _TRANSIENT_HTTP
     if isinstance(exc, urllib.error.URLError):
@@ -56,9 +79,13 @@ def is_conn_failure(exc: BaseException) -> bool:
     attempt. An HTTP-level error (the server answered with a status) is
     NOT a failover signal — a 503 mid-election heals by *waiting* (the
     retry policy's backoff), not by asking another follower, and a 4xx
-    would be identical everywhere."""
+    would be identical everywhere. A permanent-errno OSError (ENOSPC,
+    EROFS) is local to THIS process's disk, not the peer — rotating
+    replicas cannot help either."""
     if isinstance(exc, urllib.error.HTTPError):
         return False  # must precede URLError: HTTPError subclasses it
+    if _permanent_os_error(exc):
+        return False
     if isinstance(exc, urllib.error.URLError):
         return True
     return isinstance(exc, (ConnectionError, TimeoutError, OSError))

@@ -219,9 +219,10 @@ def compile_scenario(scenario) -> ScenarioPlan:
                 f"flaky_control step {ev['step']} lowered to "
                 f"after_requests={fault['after_requests']} "
                 f"(~1 GET/step/rank)")
-        elif kind == "kill_replica":
+        elif kind in ("kill_replica", "restart_replica"):
             fault = {
-                "type": "kill_config_replica",
+                "type": ("kill_config_replica" if kind == "kill_replica"
+                         else "restart_config_replica"),
                 "role": str(ev.get("role", "leader")),
                 "after_requests": int(ev["step"]) * scenario.np0,
             }
@@ -230,11 +231,34 @@ def compile_scenario(scenario) -> ScenarioPlan:
             if ev.get("path") is not None:
                 fault["path"] = str(ev["path"])
             faults.append((int(ev["step"]), fault))
+            fate = ("permanent {} death".format(fault["role"])
+                    if kind == "kill_replica" else
+                    "{} crash + WAL-replay rejoin".format(fault["role"]))
             notes.append(
-                f"kill_replica step {ev['step']} lowered to "
+                f"{kind} step {ev['step']} lowered to "
                 f"after_requests={fault['after_requests']} "
-                f"(permanent {fault['role']} death; fires only when "
+                f"({fate}; fires only when "
                 "the replay runs the replicated tier)")
+        elif kind == "kill_router":
+            fault = {
+                "type": "kill_router",
+                # router traffic is serve-plane: after_requests counts
+                # the ROUTER'S OWN requests (chaos.on_router_request),
+                # not the ~1-GET/step/rank control-plane index — the
+                # step anchor is best-effort, stated in the note
+                "after_requests": int(ev["step"]) * scenario.np0,
+            }
+            if ev.get("router") is not None:
+                fault["router"] = int(ev["router"])
+            if ev.get("path") is not None:
+                fault["path"] = str(ev["path"])
+            faults.append((int(ev["step"]), fault))
+            notes.append(
+                f"kill_router step {ev['step']} lowered to "
+                f"after_requests={fault['after_requests']} against the "
+                "router's OWN serve-plane counter (workload-dependent "
+                "anchor; fires only when the replay fronts the tier "
+                "with admission routers)")
         elif kind == "partition":
             netns.append((str(ev["host"]), float(ev["at_ms"]),
                           float(ev["heal_ms"])))
@@ -273,7 +297,8 @@ def compile_scenario(scenario) -> ScenarioPlan:
         bounds = sorted(int(e["step"]) for e in cluster_preempts)
         for anchor, f in faults:
             if (f["type"] in ("delay_http", "refuse_http",
-                              "kill_config_replica")
+                              "kill_config_replica",
+                              "restart_config_replica", "kill_router")
                     and anchor > bounds[0]):
                 raise ValueError(
                     f"scenario {scenario.name!r}: flaky_control at "

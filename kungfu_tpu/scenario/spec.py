@@ -29,6 +29,10 @@ Spec format::
         "mode": "delay", "ms": 150},          # config server degrades
        {"kind": "kill_replica", "step": 6,
         "role": "leader"},                    # config replica dies FOREVER
+       {"kind": "restart_replica", "step": 6,
+        "role": "follower"},                  # crash + WAL-replay rejoin
+       {"kind": "kill_router", "step": 5,
+        "router": 0},                         # admission router dies
        {"kind": "partition", "host": "a", "at_ms": 3000,
         "heal_ms": 5500}                      # netns link flap
      ],
@@ -63,6 +67,19 @@ Event kinds (each validated by `load_scenario`):
   single config server the fault never fires (the hook is
   replica-only), so the scenario only means something when the
   replay runs the tier.
+- ``restart_replica`` — same matching as ``kill_replica`` but the
+  victim crash-RESTARTS: it loses all memory, replays its
+  write-ahead log, rejoins ``behind`` and is repaired by the tier
+  (lowered to the ``restart_config_replica`` chaos fault; only
+  meaningful when the tier runs with ``KF_CP_WAL_DIR`` set — a
+  WAL-less victim has nothing to replay and dies permanently).
+- ``kill_router`` — one admission router (serve/router.py) dies
+  permanently starting roughly at ``step``, pinned by optional
+  ``router`` index. Lowered to the ``kill_router`` chaos fault,
+  whose ``after_requests`` counts the ROUTER'S OWN serve-plane
+  requests — workload-dependent, so the step coordinate is a
+  best-effort anchor, not the ~1-GET/step/rank mapping the
+  control-plane faults enjoy.
 - ``partition`` — netns link flap on fake host ``host`` between
   wall offsets ``at_ms`` and ``heal_ms`` (needs the FakeNet fabric;
   the chaos matrix runs these, everything else runs anywhere).
@@ -80,7 +97,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List
 
 _EVENT_KINDS = ("preempt", "resize", "straggler", "flaky_control",
-                "kill_replica", "partition")
+                "kill_replica", "restart_replica", "kill_router",
+                "partition")
 
 _REQUIRED = {
     "preempt": ("step",),
@@ -88,6 +106,8 @@ _REQUIRED = {
     "straggler": ("step", "rank", "duration_steps", "ms"),
     "flaky_control": ("step", "requests"),
     "kill_replica": ("step",),
+    "restart_replica": ("step",),
+    "kill_router": ("step",),
     "partition": ("host", "at_ms", "heal_ms"),
 }
 
@@ -201,16 +221,21 @@ def load_scenario(spec) -> Scenario:
             raise ValueError(
                 f"scenario {name!r}: {kind} event {n} step "
                 f"{ev['step']} outside [0, {steps}]")
-        if kind == "kill_replica":
+        if kind in ("kill_replica", "restart_replica"):
             role = str(ev.get("role", "leader"))
             if role not in ("leader", "follower"):
                 raise ValueError(
-                    f"scenario {name!r}: kill_replica event {n} role "
+                    f"scenario {name!r}: {kind} event {n} role "
                     f"{role!r} (known: leader, follower)")
             if ev.get("replica") is not None and int(ev["replica"]) < 0:
                 raise ValueError(
-                    f"scenario {name!r}: kill_replica event {n} "
+                    f"scenario {name!r}: {kind} event {n} "
                     f"replica index must be >= 0")
+        if kind == "kill_router" and ev.get("router") is not None \
+                and int(ev["router"]) < 0:
+            raise ValueError(
+                f"scenario {name!r}: kill_router event {n} router "
+                f"index must be >= 0")
         if kind == "preempt" and ev.get("host") is not None:
             if ev.get("rank") is not None:
                 raise ValueError(

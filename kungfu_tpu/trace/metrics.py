@@ -24,6 +24,16 @@ gauges it already serves. Families this repo publishes
   behind the double-buffer (writer backpressure depth).
 - ``kf_trace_dropped_events`` (gauge) — ring/ship overflow drops from
   the kftrace recorder.
+- ``kf_cp_wal_bytes_total{wal=...}`` (counter) — bytes appended to
+  each replica's control-plane write-ahead log (elastic/wal.py), one
+  record per group-commit batch.
+- ``kf_cp_fsync_ms{wal=...}`` (histogram) — per-append fsync wall
+  time: the durability price each KF_CP_COMMIT_MS window pays (zeros
+  when ``KF_CP_FSYNC=0``).
+- ``kf_cp_wal_replay_ms{wal=...}`` (histogram) — snapshot + log
+  replay time at replica (re)start; compaction
+  (``KF_CP_WAL_COMPACT_OPS``) is what keeps this flat as history
+  grows.
 
 Everything is optional: components update metrics unconditionally
 (cost is nanoseconds), and the families simply render empty until the

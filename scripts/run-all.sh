@@ -459,6 +459,81 @@ print(f"ROUTER SMOKE OK: router 0 killed mid-traffic, 12/12 served "
       f"zero drops")
 EOF
 
+echo "== [4k/7] durable control plane: whole-tier death mid-resize, relaunch from WALs =="
+# the durability gate (docs/control_plane.md "Durability"): the SAME
+# 2-worker decode cluster as 4i, but every config replica writes a
+# write-ahead log — and the moment the mid-traffic grow commits
+# (membership v1), ALL THREE replicas are SIGKILL-crashed at once
+# while the new worker is still booting against them. After a 1 s
+# dark window the tier relaunches from its WALs on the same ports:
+# the run must complete 12/12 (zero acked writes lost — every acked
+# op was fsynced on every reachable replica before its 200), the
+# grow must survive gap-free (v1 on every member), and the ledger
+# invariants must hold. Clients ride the outage on the documented
+# retry contract (deadline sized past kill -> relaunch -> election).
+timeout 450 python - <<'EOF'
+import tempfile
+import threading
+import time
+
+from kungfu_tpu.elastic.replica import ReplicaTier
+from kungfu_tpu.serve.harness import (RESIZE_MARKERS, default_requests,
+                                      run_serve_cluster)
+
+wal_dir = tempfile.mkdtemp(prefix="kf-run-all-cp-wal-")
+tier = ReplicaTier(n=3, lease_ms=500.0, wal_dir=wal_dir)
+outage = {}
+
+
+def executioner():
+    deadline = time.monotonic() + 240.0
+    while time.monotonic() < deadline:
+        try:
+            vs = tier.stage_versions()
+        except Exception:  # mid-churn reads can race
+            vs = []
+        if vs and all(v == 1 for v in vs):
+            break
+        time.sleep(0.05)
+    else:
+        outage["error"] = "resize never landed"
+        return
+    tier.kill_all()
+    time.sleep(1.0)  # a real outage window, requests in flight
+    tier.relaunch()
+    outage["t_up"] = time.monotonic()
+
+
+ex = threading.Thread(target=executioner, daemon=True)
+try:
+    ex.start()
+    out = run_serve_cluster(
+        default_requests(12, gen_len=48), start_np=2,
+        grow_when_done=5, server=tier,
+        extra_env={**tier.env(), "KF_SERVE_MAX_BATCH": "4",
+                   "KF_SERVE_LEASE_MS": "3000",
+                   "KF_RETRY_ATTEMPTS": "12",
+                   "KF_RETRY_DEADLINE_MS": "45000"},
+        port_range="26000-26999", timeout=360, markers=RESIZE_MARKERS)
+    ex.join(30)
+    assert "error" not in outage, outage
+    assert "t_up" in outage, "tier was never relaunched"
+    st = out["stats"]
+    assert st["failed"] == 0 and st["done"] == 12, st
+    for r in tier.replicas:
+        assert not r.dead and r.status()["wal"], r.index
+    versions = tier.stage_versions()
+    assert versions == [1, 1, 1], versions
+    viol = tier.serve_ledger.check_invariants()
+    assert viol == [], viol
+    seqs = [r.seq for r in tier.replicas]
+finally:
+    tier.stop()
+print(f"DURABLE CONTROL-PLANE SMOKE OK: whole tier killed mid-resize, "
+      f"relaunched from WALs (seqs {seqs}), 12/12 served, "
+      f"stage v1 on all three members")
+EOF
+
 echo "== [5/7] examples smoke =="
 timeout 300 python examples/mnist_slp_sync.py --steps 20
 timeout 300 python examples/mnist_elastic.py --launch \

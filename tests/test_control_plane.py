@@ -904,6 +904,374 @@ class TestReplicaTier:
         assert replica_tier.serve_ledger.check_invariants() == []
 
 
+# -- durable control plane (elastic/wal.py; docs/control_plane.md) ------------
+
+
+@pytest.fixture
+def wal_tier(tmp_path):
+    """A 3-member replica tier with per-replica write-ahead logs,
+    plus the same process-global hygiene as `replica_tier`."""
+    import importlib
+
+    peer_mod = importlib.import_module("kungfu_tpu.peer")
+    from kungfu_tpu import chaos
+    from kungfu_tpu.elastic.replica import ReplicaTier
+
+    tier = ReplicaTier(n=3, lease_ms=400.0,
+                       wal_dir=str(tmp_path / "cp-wal"))
+    try:
+        yield tier
+    finally:
+        tier.stop()
+        chaos.load(None)
+        chaos._reset()
+        peer_mod.reset_transport()
+
+
+class TestWriteAheadLog:
+    """elastic/wal.py in isolation: the on-disk record contract, the
+    compaction bound, and the two loud-refusal paths (torn tail,
+    stale snapshot) — pinned against REAL corrupted files, via the
+    same `chaos.corrupt_wal` helper the fault matrix uses."""
+
+    @staticmethod
+    def _ops(start, n, kind="submit"):
+        return [{"seq": s, "kind": kind, "op": {"i": s}}
+                for s in range(start, start + n)]
+
+    def test_roundtrip_recovers_ops_term_and_vote(self, tmp_path):
+        from kungfu_tpu.elastic.wal import WriteAheadLog
+
+        w = WriteAheadLog(str(tmp_path / "w"), name="t0")
+        w.save_term(3, 4)
+        w.append_batch(2, self._ops(1, 5))
+        w.append_batch(2, self._ops(6, 3))
+        w.close()
+        rep = WriteAheadLog(str(tmp_path / "w"), name="t0").replay()
+        assert (rep.term, rep.voted_term) == (3, 4)
+        assert rep.snapshot is None
+        assert (rep.seq, rep.seq_term) == (8, 2)
+        assert [o["seq"] for o in rep.ops] == list(range(1, 9))
+        assert rep.torn_bytes == 0 and not rep.stale_snapshot
+
+    def test_snapshot_compaction_bounds_replay(self, tmp_path):
+        import os
+
+        from kungfu_tpu.elastic.wal import WriteAheadLog
+
+        w = WriteAheadLog(str(tmp_path / "w"), name="t1")
+        w.append_batch(1, self._ops(1, 8))
+        w.save_snapshot(1, 8, {"x": "state@8"})
+        assert os.path.getsize(w.log_path) == 0  # log truncated
+        w.append_batch(1, self._ops(9, 2))
+        w.close()
+        rep = WriteAheadLog(str(tmp_path / "w"), name="t1").replay()
+        # replay = snapshot + only the ops past its stamp
+        assert rep.snapshot["seq"] == 8
+        assert rep.snapshot["state"] == {"x": "state@8"}
+        assert [o["seq"] for o in rep.ops] == [9, 10]
+        assert (rep.seq, rep.seq_term) == (10, 1)
+
+    def test_torn_tail_truncates_loudly_at_checksum(
+            self, tmp_path, capsys):
+        from kungfu_tpu import chaos
+        from kungfu_tpu.elastic.wal import WriteAheadLog
+
+        d = str(tmp_path / "w")
+        w = WriteAheadLog(d, name="t2")
+        w.append_batch(1, self._ops(1, 4))
+        w.append_batch(1, self._ops(5, 4))
+        w.close()
+        chaos.corrupt_wal(d, "torn_tail", seed=7)  # cut inside rec 2
+        rep = WriteAheadLog(d, name="t2").replay()
+        assert rep.torn_bytes > 0
+        # the intact first record replays; the torn one is DROPPED,
+        # never half-applied
+        assert [o["seq"] for o in rep.ops] == [1, 2, 3, 4]
+        assert "KF_WAL_TORN" in capsys.readouterr().out
+        # ...and the file was truncated at the damage: a second replay
+        # is clean, and appends continue from the good prefix
+        rep2 = WriteAheadLog(d, name="t2").replay()
+        assert rep2.torn_bytes == 0
+        assert [o["seq"] for o in rep2.ops] == [1, 2, 3, 4]
+
+    def test_stale_snapshot_refuses_log_loudly(self, tmp_path, capsys):
+        from kungfu_tpu import chaos
+        from kungfu_tpu.elastic.wal import WriteAheadLog
+
+        d = str(tmp_path / "w")
+        w = WriteAheadLog(d, name="t3")
+        w.append_batch(1, self._ops(1, 6))
+        w.save_snapshot(1, 6, {"x": "state@6"})
+        w.append_batch(1, self._ops(7, 3))
+        w.close()
+        # an old snapshot rotted back in: its stamp regresses below
+        # the log's first op, so snapshot+log would silently regress
+        # state (op replay is not idempotent)
+        chaos.corrupt_wal(d, "stale_snapshot", seed=7)
+        rep = WriteAheadLog(d, name="t3").replay()
+        assert rep.stale_snapshot
+        assert rep.ops == []  # the log is refused, not half-replayed
+        assert rep.seq == rep.snapshot["seq"] < 6
+        assert "KF_WAL_STALE_SNAPSHOT" in capsys.readouterr().out
+
+    def test_corrupt_meta_recovers_conservatively(
+            self, tmp_path, capsys):
+        from kungfu_tpu.elastic.wal import WriteAheadLog
+
+        d = str(tmp_path / "w")
+        w = WriteAheadLog(d, name="t4")
+        w.save_term(5, 6)
+        with open(w.meta_path, "w") as f:
+            f.write("{torn")
+        rep = WriteAheadLog(d, name="t4").replay()
+        assert (rep.term, rep.voted_term) == (0, 0)
+        assert "KF_WAL_META_CORRUPT" in capsys.readouterr().out
+
+
+class TestDurableTier:
+    """The WAL wired into the replica tier: crash-restart rejoin,
+    ENOSPC fail-fast, and whole-tier death recovery."""
+
+    @pytest.mark.chaos
+    def test_torture_follower_crash_restart_replays_wal(
+            self, wal_tier):
+        """The PR 17 torture test upgraded from listener-flap to REAL
+        restart: the follower loses all memory (fresh ledger, zeroed
+        seq/term), replays its WAL, answers `behind`, and is repaired
+        — every id acked during the dark window must be present and
+        projection-equal afterwards."""
+        import threading as _threading
+        import time
+
+        from kungfu_tpu.serve import frontend
+
+        lead = wal_tier.wait_leader(10)
+        from kungfu_tpu.peer import put_url
+        from kungfu_tpu.retrying import NO_RETRY
+
+        put_url(lead.base + "/put", _mk_stage().to_json(),
+                retry=NO_RETRY)
+        for r in wal_tier.replicas:
+            r.serve_ledger.max_queue = 100_000
+        # highest-index follower: longest election timeout, so the
+        # dark window cannot trip a spurious candidacy
+        fol = max((r for r in wal_tier.replicas
+                   if r.index != lead.index), key=lambda r: r.index)
+        stop = _threading.Event()
+        errs: list = []
+        acked: list = []
+
+        def pump(k):
+            i = 0
+            while not stop.is_set():
+                try:
+                    rid = frontend.submit(lead.get_url,
+                                          [300 + k, i % 7 + 1], 2,
+                                          retry=None)
+                    acked.append(rid)
+                except Exception as e:  # noqa: BLE001 — test FAILS on any
+                    errs.append(e)
+                    return
+                i += 1
+
+        threads = [_threading.Thread(target=pump, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        pre_crash_seq = fol.seq
+        fol.crash()      # abrupt: no drain, memory gone
+        time.sleep(0.4)  # acked mutations pile up while it's dark
+        fol.reincarnate()
+        assert fol.seq >= pre_crash_seq > 0  # WAL replay, not amnesia
+        time.sleep(0.3)  # more traffic lands post-restart
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert errs == [], errs
+        assert len(acked) == len(set(acked)), "duplicate request ids"
+        assert len(acked) > 20, "torture produced too little traffic"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            ls, fs = lead.status(), fol.status()
+            if ls["role"] == "leader" and fs["seq"] == ls["seq"] \
+                    and fs["seq_term"] == ls["seq_term"] \
+                    and _ledger_projection(fol.serve_ledger.snapshot()) \
+                    == _ledger_projection(lead.serve_ledger.snapshot()):
+                break
+            time.sleep(0.05)
+        assert fol.status()["seq"] == lead.status()["seq"]
+        fol_proj = _ledger_projection(fol.serve_ledger.snapshot())
+        assert fol_proj == _ledger_projection(
+            lead.serve_ledger.snapshot())
+        assert set(acked) <= set(fol_proj["reqs"]), \
+            "acked request lost across the crash-restart"
+        assert wal_tier.serve_ledger.check_invariants() == []
+        assert fol.status()["wal"] and fol.wal_replay_ms >= 0.0
+
+    @pytest.mark.chaos
+    def test_restart_config_replica_chaos_fault_rejoins(
+            self, wal_tier):
+        """The scenario-facing fault: `restart_config_replica` crashes
+        the pinned replica, which relaunches from its WAL and rejoins
+        the quorum without disturbing the leader."""
+        import time
+        import urllib.request
+
+        from kungfu_tpu import chaos
+
+        lead = wal_tier.wait_leader(10)
+        from kungfu_tpu.peer import put_url
+        from kungfu_tpu.retrying import NO_RETRY
+
+        put_url(lead.base + "/put", _mk_stage(1).to_json(),
+                retry=NO_RETRY)
+        fol = max((r for r in wal_tier.replicas
+                   if r.index != lead.index), key=lambda r: r.index)
+        old_ledger = id(fol.serve_ledger)
+        chaos.load({"faults": [{"type": "restart_config_replica",
+                                "replica": fol.index,
+                                "role": "follower"}]})
+        # any request to the victim trips the hook
+        try:
+            urllib.request.urlopen(fol.base + "/get", timeout=5)
+        except Exception:  # noqa: BLE001 — the crash may drop the conn
+            pass
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if not fol.dead and id(fol.serve_ledger) != old_ledger \
+                    and fol.seq == lead.seq and lead.role == "leader" \
+                    and wal_tier.stage_versions() == [1, 1, 1]:
+                break
+            time.sleep(0.05)
+        assert not fol.dead
+        assert id(fol.serve_ledger) != old_ledger  # real amnesia
+        assert fol.seq == lead.seq
+        assert lead.role == "leader"  # live traffic undisturbed
+        assert wal_tier.stage_versions() == [1, 1, 1]
+        # the fault was consumed (the rejoin above can only have come
+        # from the injected crash-restart)
+        sched = chaos.active()
+        assert all(f.remaining == 0 for f in sched.faults
+                   if f.type == "restart_config_replica")
+
+    @pytest.mark.chaos
+    def test_wal_enospc_dies_loudly_never_acks(self, wal_tier, capfd):
+        """A leader that cannot persist must not ack: the injected
+        ENOSPC fails the in-flight write (503, never 200), kills the
+        victim loudly, and the tier elects a survivor with every
+        previously-acked id intact."""
+        import time
+
+        from kungfu_tpu import chaos
+        from kungfu_tpu.serve import frontend
+
+        lead = wal_tier.wait_leader(10)
+        acked = [frontend.submit(lead.get_url, [1, 2], 2, retry=None)
+                 for _ in range(5)]
+        chaos.load({"faults": [{"type": "wal_enospc",
+                                "replica": lead.index}]})
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            frontend.submit(lead.get_url, [9, 9], 2, retry=None)
+        assert ei.value.code == 503
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not lead.dead:
+            time.sleep(0.02)
+        assert lead.dead, "ENOSPC must kill the replica, not linger"
+        assert "KF_WAL_FAIL" in capfd.readouterr().out
+        new = wal_tier.wait_leader(15)
+        assert new.index != lead.index
+        snap_reqs = {int(r["id"])
+                     for r in new.serve_ledger.snapshot()["reqs"]}
+        assert set(acked) <= snap_reqs, "acked write lost to ENOSPC"
+
+    @pytest.mark.chaos
+    def test_whole_tier_death_relaunch_loses_no_acked_writes(
+            self, wal_tier):
+        """Every replica crashed at once mid-traffic, the tier
+        relaunched from WALs on the same ports: zero acked writes
+        lost, membership versions gap-free across the outage, ledger
+        invariants clean, and the tier keeps serving."""
+        import threading as _threading
+        import time
+
+        from kungfu_tpu.serve import frontend
+
+        lead = wal_tier.wait_leader(10)
+        from kungfu_tpu.peer import put_url
+        from kungfu_tpu.retrying import NO_RETRY
+
+        put_url(lead.base + "/put", _mk_stage(1).to_json(),
+                retry=NO_RETRY)
+        for r in wal_tier.replicas:
+            r.serve_ledger.max_queue = 100_000
+        stop = _threading.Event()
+        acked: list = []
+
+        def pump(k):
+            # tolerant pump: the tier DIES mid-run, so errors during
+            # the dark window are the point — only 200s count
+            i = 0
+            while not stop.is_set():
+                cur = wal_tier.leader()
+                if cur is None:
+                    time.sleep(0.05)
+                    continue
+                try:
+                    rid = frontend.submit(cur.get_url,
+                                          [400 + k, i % 5 + 1], 2,
+                                          retry=None)
+                    acked.append(rid)
+                except Exception:  # noqa: BLE001 — outage window
+                    time.sleep(0.02)
+                i += 1
+
+        threads = [_threading.Thread(target=pump, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        n_before = len(acked)
+        wal_tier.kill_all()   # whole-tier death, no drain
+        time.sleep(0.3)       # a real outage: clients see it dark
+        wal_tier.relaunch()   # back from the WALs, same ports
+        new = wal_tier.wait_leader(15)
+        time.sleep(0.3)       # traffic lands on the new incarnation
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert n_before > 10, "no traffic acked before the outage"
+        assert len(acked) == len(set(acked)), "duplicate request ids"
+        # replay actually happened on every member
+        for r in wal_tier.replicas:
+            assert r.status()["wal"], r.index
+        # convergence: all three replicas agree, every acked id
+        # (before AND after the outage) present everywhere
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            seqs = [r.seq for r in wal_tier.replicas]
+            if len(set(seqs)) == 1 and wal_tier.leader() is not None:
+                break
+            time.sleep(0.05)
+        assert len({r.seq for r in wal_tier.replicas}) == 1
+        for r in wal_tier.replicas:
+            proj = _ledger_projection(r.serve_ledger.snapshot())
+            assert set(acked) <= set(proj["reqs"]), (
+                f"replica {r.index} lost acked writes across "
+                "whole-tier death")
+        # membership versions continue gap-free: the pre-outage v1
+        # survived, and the next mutation lands as v2 on everyone
+        assert wal_tier.stage_versions() == [1, 1, 1]
+        new = wal_tier.wait_leader(5)
+        put_url(new.base + "/put", _mk_stage(2).to_json(),
+                retry=NO_RETRY)
+        assert wal_tier.stage_versions() == [2, 2, 2]
+        assert wal_tier.serve_ledger.check_invariants() == []
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_leader_killed_mid_resize_with_live_traffic(tmp_path):
@@ -960,3 +1328,81 @@ def test_leader_killed_mid_resize_with_live_traffic(tmp_path):
         tier.stop()
         chaos.load(None)
         chaos._reset()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_whole_tier_death_mid_resize_with_live_traffic(tmp_path):
+    """The durability acceptance story (docs/control_plane.md
+    "Durability"): a real decode tier serves a live mix against the
+    replicated control plane; the moment the mid-traffic grow commits
+    (membership v1), EVERY config replica is crashed at once — no
+    drain, no survivor — while the new worker is still booting
+    against it. The tier relaunches from its WALs on the same ports
+    and the run must complete: zero acked writes lost (12/12 served —
+    in-flight leases resume via expiry), the grow preserved gap-free
+    (v1 on every member), ledger invariants clean."""
+    import threading as _threading
+    import time
+
+    from kungfu_tpu.elastic.replica import ReplicaTier
+    from kungfu_tpu.serve.harness import (RESIZE_MARKERS,
+                                          default_requests,
+                                          run_serve_cluster)
+
+    tier = ReplicaTier(n=3, lease_ms=500.0,
+                       wal_dir=str(tmp_path / "cp-wal"))
+    outage = {}
+
+    def executioner():
+        # arm on the resize landing: versions reach 1 on the tier
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            try:
+                vs = tier.stage_versions()
+            except Exception:  # noqa: BLE001 — mid-churn reads can race
+                vs = []
+            if vs and all(v == 1 for v in vs):
+                break
+            time.sleep(0.05)
+        else:
+            outage["error"] = "resize never landed"
+            return
+        tier.kill_all()
+        outage["t_dark"] = time.monotonic()
+        time.sleep(1.0)  # a real outage window, requests in flight
+        tier.relaunch()
+        outage["t_up"] = time.monotonic()
+
+    ex = _threading.Thread(target=executioner, daemon=True)
+    try:
+        ex.start()
+        out = run_serve_cluster(
+            default_requests(12, gen_len=48), start_np=2,
+            grow_when_done=5, server=tier,
+            extra_env={**tier.env(), "KF_SERVE_MAX_BATCH": "4",
+                       "KF_SERVE_LEASE_MS": "3000",
+                       # the retry deadline must cover the WHOLE
+                       # outage (kill -> relaunch -> election), or
+                       # workers give up while the tier is down
+                       "KF_RETRY_ATTEMPTS": "12",
+                       "KF_RETRY_DEADLINE_MS": "45000"},
+            logdir=str(tmp_path), port_range="27600-27699",
+            timeout=360, markers=RESIZE_MARKERS)
+        ex.join(30)
+        assert "error" not in outage, outage
+        assert "t_up" in outage, "tier was never relaunched"
+        st = out["stats"]
+        # every request completes: acked submits survived the tier's
+        # death on disk, leases resumed via expiry after relaunch
+        assert st["failed"] == 0 and st["done"] == 12
+        # the whole tier actually died and came back from its WALs
+        for r in tier.replicas:
+            assert not r.dead and r.status()["wal"], r.index
+        # gap-free membership: the pre-outage grow (v1) survived on
+        # every member — no version was lost or re-minted
+        versions = tier.stage_versions()
+        assert versions == [1, 1, 1], versions
+        assert tier.serve_ledger.check_invariants() == []
+    finally:
+        tier.stop()

@@ -7,6 +7,7 @@ jittered backoff, permanent faults surface immediately, and deadlines
 beat attempt budgets.
 """
 
+import errno
 import io
 import urllib.error
 
@@ -33,6 +34,38 @@ def test_taxonomy_transient_vs_fatal():
         assert not is_transient(_http_error(code)), code
     assert not is_transient(ValueError("bad json"))
     assert not is_transient(KeyError("version"))
+
+
+def test_taxonomy_disk_errnos_are_permanent():
+    # a full or read-only disk cannot heal within a retry budget —
+    # retrying burns the deadline then fails with a misleading timeout
+    for eno in (errno.ENOSPC, errno.EROFS):
+        exc = OSError(eno, "disk")
+        assert not is_transient(exc), errno.errorcode[eno]
+        assert not retrying.is_conn_failure(exc), errno.errorcode[eno]
+    # ...including when the socket layer wraps it in a URLError
+    wrapped = urllib.error.URLError(OSError(errno.ENOSPC, "disk"))
+    assert not is_transient(wrapped)
+    assert not retrying.is_conn_failure(wrapped)
+    # other errnos keep their transient classification (refused, reset)
+    for eno in (errno.ECONNREFUSED, errno.ECONNRESET, errno.ETIMEDOUT):
+        assert is_transient(OSError(eno, "net")), errno.errorcode[eno]
+    # errno-less OSError stays transient: no evidence it is the disk
+    assert is_transient(OSError("plain"))
+
+
+def test_permanent_errno_raises_without_retry():
+    p = RetryPolicy(attempts=5, base_ms=1, _sleep=lambda s: None)
+    calls = []
+
+    def full_disk():
+        calls.append(1)
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    with pytest.raises(OSError) as ei:
+        p.run(full_disk)
+    assert ei.value.errno == errno.ENOSPC  # real errno, not a timeout
+    assert len(calls) == 1
 
 
 def test_retries_transient_until_success():

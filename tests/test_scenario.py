@@ -254,6 +254,65 @@ def test_faults_distribute_to_the_phase_that_executes_them():
             tail[0]["count"]) == (9, 11, 3)
 
 
+def test_replica_events_lower_to_chaos_faults():
+    """kill_replica / restart_replica / kill_router ride spec -> plan:
+    same step*np0 request-index anchor as flaky_control, permanent vs
+    crash-restart fates lower to distinct chaos fault types, and the
+    optional pins (replica, router, path) survive verbatim."""
+    plan = compile_scenario({
+        "name": "cp-churn", "np0": 2, "steps": 12, "events": [
+            {"kind": "kill_replica", "step": 6, "role": "leader",
+             "path": "/addworker"},
+            {"kind": "restart_replica", "step": 4, "role": "follower",
+             "replica": 2},
+            {"kind": "kill_router", "step": 5, "router": 0},
+        ]})
+    (phase,) = plan.phases
+    faults = phase.chaos["faults"]
+    assert {"type": "kill_config_replica", "role": "leader",
+            "after_requests": 12, "path": "/addworker"} in faults
+    assert {"type": "restart_config_replica", "role": "follower",
+            "after_requests": 8, "replica": 2} in faults
+    assert {"type": "kill_router", "after_requests": 10,
+            "router": 0} in faults
+    # each lowering documents its anchor approximation on the notes
+    assert any("restart_replica" in n for n in plan.notes)
+    assert any("kill_router" in n and "OWN" in n for n in plan.notes)
+    # and the emitted faults parse as a real chaos schedule (an
+    # unknown type would otherwise only fail inside a subprocess)
+    from kungfu_tpu.chaos import ChaosSchedule
+    ChaosSchedule(phase.chaos)
+
+
+def test_replica_event_validation_is_loud():
+    base = {"name": "r", "np0": 2, "steps": 8}
+    with pytest.raises(ValueError, match="role"):
+        load_scenario({**base, "events": [
+            {"kind": "restart_replica", "step": 2, "role": "bystander"}]})
+    with pytest.raises(ValueError, match=">= 0"):
+        load_scenario({**base, "events": [
+            {"kind": "restart_replica", "step": 2, "replica": -1}]})
+    with pytest.raises(ValueError, match=">= 0"):
+        load_scenario({**base, "events": [
+            {"kind": "kill_router", "step": 2, "router": -1}]})
+    with pytest.raises(ValueError, match="missing"):
+        load_scenario({**base, "events": [
+            {"kind": "kill_router"}]})
+
+
+def test_replica_events_past_a_cluster_preempt_refuse_loudly():
+    # same reasoning as flaky_control: the request-index anchor counts
+    # from a fresh boot whose restore step is not plan data
+    for kind, extra in (("restart_replica", {}),
+                        ("kill_router", {"router": 0})):
+        with pytest.raises(ValueError, match="preempt"):
+            compile_scenario({
+                "name": "late", "np0": 2, "steps": 15, "events": [
+                    {"kind": "preempt", "step": 5, "scope": "cluster"},
+                    {"kind": kind, "step": 9, **extra},
+                ]})
+
+
 def test_flaky_control_past_a_cluster_preempt_refuses_loudly():
     """A control-plane flap after a whole-allocation preemption cannot
     lower: its request-index threshold counts from a fresh server boot
