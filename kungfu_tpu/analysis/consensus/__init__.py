@@ -1,0 +1,37 @@
+"""kfconsensus — static verification of the replicated control plane.
+
+Three pieces, layered on the kfverify ``ProjectIndex``:
+
+- :mod:`.extract` lifts the election/replication state machine out of
+  ``elastic/replica.py`` + ``elastic/wal.py`` into a
+  :class:`~kungfu_tpu.analysis.consensus.extract.ConsensusSpec`,
+  RAISING when the code drifts from the shapes it matches — the model
+  is only evidence while it provably mirrors the implementation;
+- :mod:`.model` runs that spec over every 2–3-replica interleaving of
+  election × group-commit × crash-restart × WAL replay and checks the
+  four consensus invariants (at-most-one-leader-per-term,
+  no-double-vote-after-restart, every-acked-write-survives-a-crash,
+  follower seq-gap-freedom), plus 12 MUST-FIRE ablations replaying
+  the PR 16/17/18 incident shapes with one guard removed each;
+- :mod:`.passes` contributes three whole-tree lint passes
+  (``ack-ordering``, ``term-fence``, ``handler-exception-safety``)
+  to the 17-pass registry in :mod:`kungfu_tpu.analysis.core`.
+
+CLI: ``python -m kungfu_tpu.analysis.consensus`` (``--json``,
+``--baseline`` ride the same stable-ID machinery as kflint).
+"""
+
+from .extract import (ConsensusSpec, consensus_paths, default_spec,
+                      extract_consensus_spec)
+from .model import (ABLATIONS, SCENARIOS, Violation, World, ablate,
+                    explore_consensus)
+from .passes import (AckOrderingPass, HandlerExceptionSafetyPass,
+                     TermFencePass)
+
+__all__ = [
+    "ConsensusSpec", "consensus_paths", "default_spec",
+    "extract_consensus_spec",
+    "ABLATIONS", "SCENARIOS", "Violation", "World", "ablate",
+    "explore_consensus",
+    "AckOrderingPass", "TermFencePass", "HandlerExceptionSafetyPass",
+]

@@ -1,0 +1,190 @@
+"""kfconsensus CLI: ``python -m kungfu_tpu.analysis.consensus``.
+
+The consensus gate, in one run:
+
+1. **extract** — lift the election/replication state machine out of
+   ``elastic/replica.py`` + ``elastic/wal.py``; ANY drift between the
+   code and the shapes the extractor matches aborts the run loudly
+   (exit 1) — a model of code it no longer mirrors proves nothing;
+2. **must-hold** — every 2–3-replica interleaving of election ×
+   group-commit × crash-restart × WAL replay upholds the four
+   invariants (at-most-one-leader-per-term, no double vote across
+   restarts, every acked write survives a single crash, follower
+   seq-gap freedom);
+3. **must-fire** — re-run the scope once per ablation with exactly
+   one guard removed (the PR 16/17/18 incident shapes); an ablation
+   that produces NO divergence means the model lost the very hazard
+   the guard exists for, and fails the gate just as hard.
+
+Violations and silent ablations surface as kflint-style findings, so
+``--json`` / ``--baseline`` ride the same stable-ID machinery as
+``python -m kungfu_tpu.analysis`` and CI diffs instead of gating on
+absolute counts. The committed baseline lives at
+``scripts/kfconsensus_baseline.json`` (empty: the gate is clean).
+
+``--show ABLATION`` prints the first divergence trace for one
+ablation — the incident replay, step by step.
+
+Exit status: 0 clean, 1 violations / silent ablations / drift /
+new-vs-baseline, 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from types import SimpleNamespace
+
+from ..core import Finding
+from ..__main__ import (diff_baseline, finding_id, load_baseline,
+                        to_json)
+from .extract import consensus_paths, default_spec
+from .model import ABLATIONS, SCENARIOS, ablate, explore_consensus
+
+#: findings anchor on the file whose guard the violation concerns
+_ANCHOR = "kungfu_tpu/elastic/replica.py"
+
+_PASSES = (SimpleNamespace(name="consensus-model"),
+           SimpleNamespace(name="consensus-ablation"))
+
+
+def _model_findings(violations) -> list:
+    out = []
+    for v in violations:
+        out.append(Finding(
+            path=_ANCHOR, line=1, pass_name="consensus-model",
+            message=f"{v.invariant} violated in scenario "
+                    f"{v.scenario}: {v.detail}"))
+    return out
+
+
+def _ablation_findings(silent) -> list:
+    return [Finding(
+        path=_ANCHOR, line=1, pass_name="consensus-ablation",
+        message=f"MUST-FIRE ablation {name!r} produced no divergence "
+                "— the model no longer exercises the hazard this "
+                "guard exists for")
+        for name in silent]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kungfu_tpu.analysis.consensus",
+        description="kfconsensus: small-scope model checking of the "
+                    "replicated control plane against the spec "
+                    "extracted from elastic/replica.py + wal.py "
+                    "(see docs/static_analysis.md)")
+    ap.add_argument("--scope", default="2,3", metavar="N[,N...]",
+                    help="replica counts to explore (default: 2,3)")
+    ap.add_argument("--list", action="store_true", dest="list_parts",
+                    help="list scenarios and must-fire ablations, "
+                         "then exit")
+    ap.add_argument("--show", metavar="ABLATION",
+                    help="print the first divergence trace for one "
+                         "ablation and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings with stable IDs")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="diff findings against a committed baseline: "
+                         "exit 1 only on NEW finding IDs")
+    args = ap.parse_args(argv)
+
+    if args.list_parts:
+        print("scenarios:")
+        for name, fn in SCENARIOS:
+            doc = (fn.__doc__ or "").strip().split("\n")[0]
+            print(f"  {name:24s} {doc}")
+        print("must-fire ablations:")
+        for name in ABLATIONS:
+            print(f"  {name}")
+        return 0
+
+    try:
+        scope = tuple(int(x) for x in args.scope.split(",") if x)
+    except ValueError:
+        print(f"kfconsensus: bad --scope {args.scope!r} (want e.g. "
+              "2,3)", file=sys.stderr)
+        return 2
+    if not scope or any(n < 2 or n > 3 for n in scope):
+        print("kfconsensus: --scope entries must be 2 or 3 (the "
+              "small-scope hypothesis is argued for that range only)",
+              file=sys.stderr)
+        return 2
+
+    try:
+        spec = default_spec()
+    except (ValueError, OSError) as e:
+        # drift: the code moved out from under the model — that is a
+        # gate failure, never a skip
+        print(f"kfconsensus: {e}", file=sys.stderr)
+        return 1
+    print(f"kfconsensus: extracted consensus spec from "
+          f"{', '.join(consensus_paths())}", file=sys.stderr)
+
+    if args.show:
+        if args.show not in ABLATIONS:
+            print(f"kfconsensus: unknown ablation {args.show!r} "
+                  f"(known: {', '.join(sorted(ABLATIONS))})",
+                  file=sys.stderr)
+            return 2
+        violations = explore_consensus(ablate(spec, args.show),
+                                       scope=scope)
+        if not violations:
+            print(f"kfconsensus: ablation {args.show!r} produced no "
+                  "divergence", file=sys.stderr)
+            return 1
+        print(violations[0].trace())
+        return 0
+
+    findings = _model_findings(explore_consensus(spec, scope=scope))
+    silent = []
+    for name in ABLATIONS:
+        if not explore_consensus(ablate(spec, name), scope=scope):
+            silent.append(name)
+    findings.extend(_ablation_findings(silent))
+
+    new = fixed = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"kfconsensus: cannot read baseline "
+                  f"{args.baseline}: {e}", file=sys.stderr)
+            return 2  # an unreadable baseline must not green the gate
+        new, fixed = diff_baseline({finding_id(f) for f in findings},
+                                   baseline)
+
+    if args.as_json:
+        print(to_json(findings, _PASSES, new, fixed))
+    else:
+        for f in findings:
+            marker = ""
+            if new is not None:
+                marker = ("" if finding_id(f) in new
+                          else " [baseline]")
+            print(f"{f}{marker}")
+
+    n_abl = len(ABLATIONS)
+    summary = (f"{len(findings)} finding(s); scope={scope}; "
+               f"{n_abl - len(silent)}/{n_abl} ablations fired")
+    if args.baseline:
+        if fixed:
+            print(f"kfconsensus: {len(fixed)} baseline finding(s) "
+                  "fixed — regenerate the baseline to ratchet",
+                  file=sys.stderr)
+        if new:
+            print(f"kfconsensus: {len(new)} NEW finding(s) vs "
+                  f"baseline ({summary})", file=sys.stderr)
+            return 1
+        print(f"kfconsensus: no new findings vs baseline ({summary})",
+              file=sys.stderr)
+        return 0
+    if findings:
+        print(f"kfconsensus: {summary}", file=sys.stderr)
+        return 1
+    print(f"kfconsensus: clean ({summary})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,474 @@
+"""kfconsensus extractor: lift the consensus state machine out of the code.
+
+The model checker in :mod:`.model` explores a SPEC of the replicated
+control plane — term/vote transitions, the append→WAL→push→ack
+dataflow, the ``(seq_term, seq)`` vote-completeness guard — not the
+code itself. A hand-written spec rots: the PR 5 lesson (the bucket
+name template in ``protocol/explore.py``) is that the model must be
+EXTRACTED from the tree and the extraction must RAISE when the code
+drifts, so the checker can never keep proving a machine the code no
+longer implements.
+
+This module walks the kfverify :class:`ProjectIndex` over
+``elastic/replica.py`` + ``elastic/wal.py`` and matches the exact AST
+shapes of every guard the model relies on:
+
+- ``_on_vote``: the ``granted = req_term > max(self.term,
+  self.voted_term)`` term rule (the comparison OPERATOR is extracted —
+  a drift to ``>=`` re-grants within a term), the
+  ``(self.seq_term, self.seq)`` log-completeness tuple (order
+  matters), and ``_wal_save_term`` ordered before the grant returns;
+- ``_run_election``: the candidacy persisted before the vote sweep;
+- ``_commit``: WAL append before the first ``apply_delta`` push,
+  the ``entry["ok"] = True`` ack after it, and the fenced-409
+  step-down-and-fail path before the ack;
+- ``_on_apply_delta`` / ``_on_apply`` / ``_on_heartbeat``: the
+  stale-term 409 fences, the seq-domain gap answer, the strict
+  ``expect = self.seq + 1`` contiguity run, the same-domain duplicate
+  guard, the domain-aware ``behind`` rule;
+- ``_push_state`` / ``_push_snapshot_to``: the snapshot built
+  lexically under ``_mut_mu`` (the stamp must be exact — op replay is
+  not idempotent);
+- ``wal.py``: ``replay`` truncating a torn tail, ``_read_records``
+  verifying the per-record digest, ``save_term`` persisting through
+  the atomic tmp+fsync+rename path.
+
+Every matcher raises :class:`ValueError` naming the missing shape.
+All extracted booleans are therefore True in a returned spec; they
+exist as FIELDS so the checker's MUST-FIRE fixtures can ablate each
+guard with ``dataclasses.replace`` and prove the scenario catches its
+absence (the PR 16/17/18 incident shapes).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..core import Source
+from ..protocol.project import FuncInfo, ProjectIndex
+
+#: attribute spelling of the two election-state fields, in the order
+#: the term rule compares them
+_TERM_ATTRS = ("term", "voted_term")
+
+
+@dataclass(frozen=True)
+class ConsensusSpec:
+    """The extracted control-plane state machine, one field per guard.
+
+    Extraction always yields the safe value for every field; the model
+    checker ablates individual fields (``dataclasses.replace``) to
+    prove each MUST-FIRE incident fixture diverges without its guard.
+    """
+
+    vote_term_op: str          # ">": an equal/stale term never re-grants
+    vote_log_position: bool    # §5.4.1 completeness: (seq_term, seq) >= voter's
+    persist_before_grant: bool  # meta.json durable before the grant returns
+    persist_before_sweep: bool  # candidacy durable before the vote sweep
+    wal_before_push: bool      # leader fsyncs the batch before any follower sees it
+    ack_after_replicate: bool  # entry["ok"] only after the push loop
+    step_down_on_409: bool     # fenced leader deposes itself, fails the batch
+    delta_term_fence: bool     # follower 409s a stale-term delta
+    delta_domain_check: bool   # cross-seq-domain delta answers gap
+    delta_contiguous: bool     # strict seq+1 run; first hole stops the replay
+    delta_wal_append: bool     # follower logs the replayed batch before ok
+    apply_term_fence: bool     # follower 409s a stale-term snapshot
+    apply_dup_guard: bool      # same-domain older snapshot is a no-op
+    heartbeat_domain_behind: bool  # cross-domain seq is incomparable => behind
+    snapshot_stamp_exact: bool  # snapshots stamped under _mut_mu (exact seq)
+    truncate_torn_tail: bool   # replay truncates a torn tail record
+    term_persist_atomic: bool  # save_term goes through tmp+fsync+rename
+
+
+def _fail(where: str, what: str) -> ValueError:
+    return ValueError(
+        f"kfconsensus extractor: {where}: {what} changed or moved; "
+        "the consensus surface drifted — update "
+        "kungfu_tpu/analysis/consensus/ to match (the model must "
+        "never silently diverge from the code)")
+
+
+def _method(index: ProjectIndex, name: str, suffix: str,
+            cls: Optional[str] = None) -> FuncInfo:
+    info = index.method(name, cls=cls, module_suffix=suffix)
+    if info is None:
+        raise _fail(f"{suffix}::{name}",
+                    "the anchor method (missing or ambiguous)")
+    return info
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _calls(node: ast.AST, name: str) -> List[ast.Call]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            fn = n.func
+            simple = (fn.attr if isinstance(fn, ast.Attribute)
+                      else fn.id if isinstance(fn, ast.Name) else None)
+            if simple == name:
+                out.append(n)
+    return out
+
+
+def _rpc_calls_to(node: ast.AST, route: str) -> List[ast.Call]:
+    """``_rpc(base, "/replica/<x>", ...)`` call sites for one route."""
+    return [c for c in _calls(node, "_rpc")
+            if any(isinstance(a, ast.Constant) and a.value == route
+                   for a in c.args)]
+
+
+def _has_const(node: ast.AST, value) -> bool:
+    return any(isinstance(n, ast.Constant) and n.value == value
+               for n in ast.walk(node))
+
+
+def _returns_status(node: ast.AST, status: int) -> bool:
+    """A Return under ``node`` whose tuple starts with ``status``."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Return) and isinstance(n.value, ast.Tuple) \
+                and n.value.elts and isinstance(n.value.elts[0],
+                                                ast.Constant) \
+                and n.value.elts[0].value == status:
+            return True
+    return False
+
+
+# -- replica.py matchers ------------------------------------------------------
+
+def _extract_vote(fn: FuncInfo) -> Tuple[str, bool, bool]:
+    where = "replica.py::_on_vote"
+    op = None
+    for n in ast.walk(fn.node):
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and n.targets[0].id == "granted"
+                and isinstance(n.value, ast.Compare)
+                and isinstance(n.value.left, ast.Name)
+                and n.value.left.id == "req_term"
+                and len(n.value.ops) == 1):
+            continue
+        cmp = n.value.comparators[0]
+        if (isinstance(cmp, ast.Call) and isinstance(cmp.func, ast.Name)
+                and cmp.func.id == "max"
+                and tuple(_self_attr(a) for a in cmp.args)
+                == _TERM_ATTRS):
+            op = {ast.Gt: ">", ast.GtE: ">="}.get(type(n.value.ops[0]))
+    if op is None:
+        raise _fail(where, "the 'granted = req_term OP max(self.term, "
+                           "self.voted_term)' term rule")
+
+    # the §5.4.1 completeness guard: mine = (self.seq_term, self.seq)
+    # — ORDER matters, term dominates — then granted = theirs >= mine
+    mine_ok = any(
+        isinstance(n, ast.Assign) and len(n.targets) == 1
+        and isinstance(n.targets[0], ast.Name)
+        and n.targets[0].id == "mine"
+        and isinstance(n.value, ast.Tuple)
+        and tuple(_self_attr(e) for e in n.value.elts)
+        == ("seq_term", "seq")
+        for n in ast.walk(fn.node))
+    cmp_ok = any(
+        isinstance(n, ast.Assign) and len(n.targets) == 1
+        and isinstance(n.targets[0], ast.Name)
+        and n.targets[0].id == "granted"
+        and isinstance(n.value, ast.Compare)
+        and isinstance(n.value.left, ast.Name)
+        and n.value.left.id == "theirs"
+        and len(n.value.ops) == 1
+        and isinstance(n.value.ops[0], ast.GtE)
+        and isinstance(n.value.comparators[0], ast.Name)
+        and n.value.comparators[0].id == "mine"
+        for n in ast.walk(fn.node))
+    if not (mine_ok and cmp_ok):
+        raise _fail(where, "the (seq_term, seq) log-completeness guard "
+                           "('mine'/'theirs >= mine')")
+
+    saves = _calls(fn.node, "_wal_save_term")
+    grants = [n for n in ast.walk(fn.node)
+              if isinstance(n, ast.Return) and n.value is not None
+              and _has_const(n, "granted")]
+    if not saves or not grants or \
+            min(s.lineno for s in saves) >= max(g.lineno for g in grants):
+        raise _fail(where, "the _wal_save_term() persisted BEFORE the "
+                           "grant returns")
+    return op, True, True
+
+
+def _extract_election(fn: FuncInfo) -> bool:
+    where = "replica.py::_run_election"
+    saves = _calls(fn.node, "_wal_save_term")
+    sweeps = _rpc_calls_to(fn.node, "/replica/vote")
+    if not saves or not sweeps or \
+            min(s.lineno for s in saves) >= min(c.lineno for c in sweeps):
+        raise _fail(where, "the candidacy persisted (_wal_save_term) "
+                           "BEFORE the /replica/vote sweep")
+    return True
+
+
+def _extract_commit(fn: FuncInfo) -> Tuple[bool, bool, bool]:
+    where = "replica.py::_commit"
+    appends = _calls(fn.node, "_wal_append")
+    pushes = _rpc_calls_to(fn.node, "/replica/apply_delta")
+    acks = [n for n in ast.walk(fn.node)
+            if isinstance(n, ast.Assign) and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Subscript)
+            and isinstance(n.targets[0].value, ast.Name)
+            and n.targets[0].value.id == "entry"
+            and isinstance(n.targets[0].slice, ast.Constant)
+            and n.targets[0].slice.value == "ok"
+            and isinstance(n.value, ast.Constant)
+            and n.value.value is True]
+    if not appends or not pushes:
+        raise _fail(where, "the _wal_append / apply_delta push pair")
+    if not acks:
+        raise _fail(where, "the 'entry[\"ok\"] = True' ack")
+    append_l = min(a.lineno for a in appends)
+    push_l = min(p.lineno for p in pushes)
+    ack_l = min(a.lineno for a in acks)
+    if not append_l < push_l:
+        raise _fail(where, "the log-then-replicate order (_wal_append "
+                           "before the push loop)")
+    if not push_l < ack_l:
+        raise _fail(where, "the replicate-before-ack order (push loop "
+                           "before entry[\"ok\"])")
+    # fencing: `except _RPCReject` classifying e.status == 409, and an
+    # `if fenced:` that steps down, fails the batch and RETURNS before
+    # the ack can run
+    fence_409 = any(
+        isinstance(h, ast.ExceptHandler)
+        and _has_const(h, 409)
+        for h in ast.walk(fn.node) if isinstance(h, ast.ExceptHandler))
+    depose = None
+    for n in ast.walk(fn.node):
+        if (isinstance(n, ast.If) and isinstance(n.test, ast.Name)
+                and n.test.id == "fenced"
+                and _calls(n, "_step_down") and _calls(n, "_fail")
+                and any(isinstance(x, ast.Return) for b in n.body
+                        for x in ast.walk(b))):
+            depose = n
+    if not fence_409 or depose is None or depose.lineno >= ack_l:
+        raise _fail(where, "the fenced-409 step-down/fail/return path "
+                           "before the ack")
+    return True, True, True
+
+
+def _extract_apply_delta(fn: FuncInfo) -> Tuple[bool, bool, bool, bool]:
+    where = "replica.py::_on_apply_delta"
+    fence = any(
+        isinstance(n, ast.If) and isinstance(n.test, ast.Compare)
+        and isinstance(n.test.left, ast.Name)
+        and n.test.left.id == "req_term"
+        and len(n.test.ops) == 1 and isinstance(n.test.ops[0], ast.Lt)
+        and _self_attr(n.test.comparators[0]) == "term"
+        and _returns_status(n, 409)
+        for n in ast.walk(fn.node))
+    if not fence:
+        raise _fail(where, "the stale-term 409 fence")
+    domain = any(
+        isinstance(n, ast.If) and isinstance(n.test, ast.Compare)
+        and isinstance(n.test.left, ast.Name)
+        and n.test.left.id == "req_term"
+        and len(n.test.ops) == 1
+        and isinstance(n.test.ops[0], ast.NotEq)
+        and _self_attr(n.test.comparators[0]) == "seq_term"
+        and _has_const(n, "gap")
+        for n in ast.walk(fn.node))
+    if not domain:
+        raise _fail(where, "the cross-seq-domain gap answer "
+                           "(req_term != self.seq_term)")
+    contiguous = any(
+        isinstance(n, ast.Assign) and len(n.targets) == 1
+        and isinstance(n.targets[0], ast.Name)
+        and n.targets[0].id == "expect"
+        and isinstance(n.value, ast.BinOp)
+        and isinstance(n.value.op, ast.Add)
+        and _self_attr(n.value.left) == "seq"
+        and isinstance(n.value.right, ast.Constant)
+        and n.value.right.value == 1
+        for n in ast.walk(fn.node)) and any(
+        isinstance(n, ast.Compare) and len(n.ops) == 1
+        and isinstance(n.ops[0], ast.NotEq)
+        and isinstance(n.comparators[0], ast.Name)
+        and n.comparators[0].id == "expect"
+        for n in ast.walk(fn.node))
+    if not contiguous:
+        raise _fail(where, "the strict 'expect = self.seq + 1' "
+                           "contiguity run")
+    if not _calls(fn.node, "_wal_append"):
+        raise _fail(where, "the follower-side _wal_append of the "
+                           "replayed batch")
+    return True, True, True, True
+
+
+def _extract_apply(fn: FuncInfo) -> Tuple[bool, bool]:
+    where = "replica.py::_on_apply"
+    fence = any(
+        isinstance(n, ast.If) and isinstance(n.test, ast.Compare)
+        and isinstance(n.test.left, ast.Name)
+        and n.test.left.id == "req_term"
+        and len(n.test.ops) == 1 and isinstance(n.test.ops[0], ast.Lt)
+        and _self_attr(n.test.comparators[0]) == "term"
+        and _returns_status(n, 409)
+        for n in ast.walk(fn.node))
+    if not fence:
+        raise _fail(where, "the stale-term 409 fence")
+    dup = any(
+        isinstance(n, ast.If) and isinstance(n.test, ast.BoolOp)
+        and isinstance(n.test.op, ast.And)
+        and len(n.test.values) == 2
+        and isinstance(n.test.values[0], ast.Compare)
+        and isinstance(n.test.values[0].ops[0], ast.Eq)
+        and _self_attr(n.test.values[0].comparators[0]) == "seq_term"
+        and isinstance(n.test.values[1], ast.Compare)
+        and isinstance(n.test.values[1].ops[0], ast.LtE)
+        and _self_attr(n.test.values[1].comparators[0]) == "seq"
+        for n in ast.walk(fn.node))
+    if not dup:
+        raise _fail(where, "the same-domain duplicate guard (req_term "
+                           "== self.seq_term and req_seq <= self.seq)")
+    return True, True
+
+
+def _extract_heartbeat(fn: FuncInfo) -> bool:
+    where = "replica.py::_on_heartbeat"
+    for n in ast.walk(fn.node):
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and n.targets[0].id == "behind"
+                and isinstance(n.value, ast.BoolOp)
+                and isinstance(n.value.op, ast.Or)
+                and len(n.value.values) == 2):
+            continue
+        first, second = n.value.values
+        if (isinstance(first, ast.Compare)
+                and isinstance(first.ops[0], ast.NotEq)
+                and _self_attr(first.left) == "seq_term"
+                and isinstance(second, ast.Compare)
+                and isinstance(second.ops[0], ast.Lt)
+                and _self_attr(second.left) == "seq"):
+            return True
+    raise _fail(where, "the domain-aware behind rule (seq_term != "
+                       "req_term or seq < req seq)")
+
+
+def _extract_snapshot_stamp(fn: FuncInfo) -> bool:
+    """Every ``state_snapshot()`` call in ``fn`` lexically under a
+    ``with ...._mut_mu:`` — the exact-stamp discipline."""
+    where = f"replica.py::{fn.name}"
+
+    hits = []
+
+    def walk(node: ast.AST, held: bool):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Attribute) \
+                        and ctx.attr == "_mut_mu":
+                    held = True
+        if isinstance(node, ast.Call):
+            fnc = node.func
+            if isinstance(fnc, ast.Attribute) \
+                    and fnc.attr == "state_snapshot":
+                hits.append(held)
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    walk(fn.node, False)
+    if not hits or not all(hits):
+        raise _fail(where, "the snapshot stamped under _mut_mu "
+                           "(state_snapshot inside 'with ..._mut_mu:')")
+    return True
+
+
+# -- wal.py matchers ----------------------------------------------------------
+
+def _extract_wal(index: ProjectIndex) -> Tuple[bool, bool]:
+    replay = _method(index, "replay", "wal.py", cls="WriteAheadLog")
+    if not _calls(replay.node, "truncate"):
+        raise _fail("wal.py::replay", "the torn-tail truncate")
+    reader = _method(index, "_read_records", "wal.py",
+                     cls="WriteAheadLog")
+    digest_checked = _calls(reader.node, "_digest") and any(
+        isinstance(n, ast.Compare) and isinstance(n.ops[0], ast.NotEq)
+        for n in ast.walk(reader.node))
+    if not digest_checked:
+        raise _fail("wal.py::_read_records",
+                    "the per-record digest verification")
+    save = _method(index, "save_term", "wal.py", cls="WriteAheadLog")
+    if not _calls(save.node, "_write_atomic"):
+        raise _fail("wal.py::save_term",
+                    "the atomic tmp+fsync+rename persist")
+    return True, True
+
+
+# -- entry points -------------------------------------------------------------
+
+def extract_consensus_spec(index: ProjectIndex) -> ConsensusSpec:
+    """Extract the spec from an index holding ``elastic/replica.py``
+    and ``elastic/wal.py``; raises ValueError on any drift."""
+    vote = _method(index, "_on_vote", "replica.py",
+                   cls="ReplicaConfigServer")
+    op, log_pos, persist_grant = _extract_vote(vote)
+    persist_sweep = _extract_election(
+        _method(index, "_run_election", "replica.py",
+                cls="ReplicaConfigServer"))
+    wal_first, ack_last, depose = _extract_commit(
+        _method(index, "_commit", "replica.py",
+                cls="ReplicaConfigServer"))
+    d_fence, d_domain, d_contig, d_wal = _extract_apply_delta(
+        _method(index, "_on_apply_delta", "replica.py",
+                cls="ReplicaConfigServer"))
+    a_fence, a_dup = _extract_apply(
+        _method(index, "_on_apply", "replica.py",
+                cls="ReplicaConfigServer"))
+    hb_domain = _extract_heartbeat(
+        _method(index, "_on_heartbeat", "replica.py",
+                cls="ReplicaConfigServer"))
+    stamp = all(_extract_snapshot_stamp(
+        _method(index, name, "replica.py", cls="ReplicaConfigServer"))
+        for name in ("_push_state", "_push_snapshot_to",
+                     "_wal_maybe_compact"))
+    torn, atomic = _extract_wal(index)
+    return ConsensusSpec(
+        vote_term_op=op,
+        vote_log_position=log_pos,
+        persist_before_grant=persist_grant,
+        persist_before_sweep=persist_sweep,
+        wal_before_push=wal_first,
+        ack_after_replicate=ack_last,
+        step_down_on_409=depose,
+        delta_term_fence=d_fence,
+        delta_domain_check=d_domain,
+        delta_contiguous=d_contig,
+        delta_wal_append=d_wal,
+        apply_term_fence=a_fence,
+        apply_dup_guard=a_dup,
+        heartbeat_domain_behind=hb_domain,
+        snapshot_stamp_exact=stamp,
+        truncate_torn_tail=torn,
+        term_persist_atomic=atomic,
+    )
+
+
+def consensus_paths() -> List[str]:
+    """The two source files the spec is extracted from."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    pkg = os.path.dirname(os.path.dirname(here))
+    return [os.path.join(pkg, "elastic", "replica.py"),
+            os.path.join(pkg, "elastic", "wal.py")]
+
+
+def default_spec() -> ConsensusSpec:
+    """Extract the spec from the repo's own control plane."""
+    index = ProjectIndex({p: Source.parse(p) for p in consensus_paths()})
+    return extract_consensus_spec(index)

@@ -1,0 +1,838 @@
+"""Small-scope model checking of the replicated control plane.
+
+Runs the EXTRACTED consensus spec (:mod:`.extract` — never a
+hand-written twin of the code) over deterministic 2–3-replica
+scenarios composing election × group-commit × crash-restart × WAL
+replay, and checks the four invariants the docs/control_plane.md
+honesty table claims:
+
+1. **at-most-one-leader-per-term** — the leaders ledger never records
+   two replicas leading the same term;
+2. **no-double-vote** — a voter never grants the same term to two
+   candidates, INCLUDING across a crash-restart (the meta.json
+   fsync-before-grant ordering, PR 18);
+3. **every-acked-write-survives** — any op acked to a client is
+   present exactly once in the settled leader's state after any
+   single crash in the scenario (replicate-before-ack, PR 16);
+4. **seq-gap-freedom / convergence** — after the repair paths settle,
+   every live replica's state equals the leader's, holds no duplicate
+   op (replay is NOT idempotent, PR 18) and no op that was never
+   issued (a torn WAL record must never replay as state, PR 17).
+
+Scope honesty — small-scope means SMALL: replicas fail by crashing
+(restartable, WAL intact) or by transiently dropping messages; there
+are no symmetric network partitions. Under a partition the tier's
+majority-of-responding elections are documented unsafe
+(`elastic/replica.py` module docstring, docs/control_plane.md) — a
+model that "proved" safety there would be lying, so the scope stops
+where the implementation's claims stop.
+
+Everything is single-threaded and deterministic: scenarios enumerate
+crash points, message-loss windows and candidacy orders explicitly
+instead of sampling thread schedules, the `explore.py` precedent.
+
+MUST-FIRE fixtures: :data:`ABLATIONS` maps each incident shape to the
+spec field whose guard prevents it. ``explore_consensus`` over an
+ablated spec must produce at least one violation (with a trace); the
+CLI and tests enforce both directions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .extract import ConsensusSpec
+
+#: state marker for a torn WAL record replayed without truncation —
+#: never in any issued-op set, so invariant 4 flags it on sight
+CORRUPT = "⊥"
+
+#: heartbeat/repair rounds the settle phase runs; 3 covers the longest
+#: repair chain a scenario can produce (gap -> snapshot -> converge)
+_SETTLE_ROUNDS = 3
+
+
+@dataclass
+class Violation:
+    """One invariant breach, with the event history that led to it."""
+
+    invariant: str
+    scenario: str
+    detail: str
+    history: List[str] = field(default_factory=list)
+
+    def trace(self) -> str:
+        lines = [f"invariant violated: {self.invariant}",
+                 f"  scenario: {self.scenario}",
+                 f"  detail:   {self.detail}",
+                 "  history:"]
+        lines += [f"    {i:3d}. {ev}" for i, ev in
+                  enumerate(self.history, 1)]
+        return "\n".join(lines)
+
+
+class MWal:
+    """A replica's durable state: meta (term, voted_term), snapshot,
+    delta-log records, and whether the tail record is torn."""
+
+    def __init__(self):
+        self.term = 0
+        self.voted_term = 0
+        self.snapshot: Optional[Tuple[int, int, Tuple[str, ...]]] = None
+        self.log: List[Tuple[int, Tuple[Tuple[int, str], ...]]] = []
+        self.torn = False  # last log record cut mid-append
+
+    def save_term(self, term: int, voted: int) -> None:
+        self.term, self.voted_term = term, voted
+
+    def save_snapshot(self, seq_term: int, seq: int,
+                      state: Tuple[str, ...]) -> None:
+        # durable snapshot supersedes the log (wal.save_snapshot
+        # truncates after the snapshot is on disk)
+        self.snapshot = (seq_term, seq, state)
+        self.log = []
+        self.torn = False
+
+
+class MReplica:
+    """One replica of the modeled tier."""
+
+    def __init__(self, idx: int, world: "World"):
+        self.idx = idx
+        self.world = world
+        self.spec = world.spec
+        self.alive = True
+        self.unreachable = False  # transient: drops its messages
+        self.wal = MWal()
+        self.term = 0
+        self.voted_term = 0
+        self.role = "follower"
+        self.seq = 0
+        self.seq_term = 0
+        self.state: Tuple[str, ...] = ()
+
+    # -- helpers -------------------------------------------------------------
+
+    @property
+    def reachable(self) -> bool:
+        return self.alive and not self.unreachable
+
+    def others(self) -> List["MReplica"]:
+        return [r for r in self.world.replicas if r is not self]
+
+    def log(self, ev: str) -> None:
+        self.world.log(f"r{self.idx}: {ev}")
+
+    # -- election ------------------------------------------------------------
+
+    def on_vote(self, term: int, cand: int, cseq: int,
+                cseq_term: int) -> Dict:
+        sp = self.spec
+        if sp.vote_term_op == ">":
+            granted = term > max(self.term, self.voted_term)
+        else:  # ablated: an equal term re-grants
+            granted = term >= max(self.term, self.voted_term)
+        if granted and sp.vote_log_position:
+            # §5.4.1 completeness: refuse a candidate behind our log
+            granted = (cseq_term, cseq) >= (self.seq_term, self.seq)
+        changed = term > self.term or granted
+        if granted:
+            self.voted_term = term
+            if self.role == "leader":
+                self.role = "follower"
+        self.term = max(self.term, term)
+        if changed and sp.persist_before_grant:
+            # durable BEFORE the candidate hears the grant
+            self.wal.save_term(self.term, self.voted_term)
+        if granted:
+            self.world.record_vote(self.idx, term, cand)
+        self.log(f"vote req t={term} from r{cand}: "
+                 f"{'granted' if granted else 'refused'}")
+        return {"granted": granted, "term": self.term}
+
+    def run_election(self, crash_mid_sweep: bool = False) -> bool:
+        """One candidacy, mirroring ``_run_election``. Returns True
+        when this replica became leader."""
+        sp = self.spec
+        if not self.alive or self.role == "leader":
+            return False
+        term = self.term + 1
+        self.voted_term = max(self.voted_term, term)  # vote for self
+        self.world.record_vote(self.idx, term, self.idx)
+        if sp.persist_before_sweep:
+            # candidacy durable before anyone hears it — a forgotten
+            # self-vote could re-vote differently at this term
+            self.wal.save_term(self.term, self.voted_term)
+        self.log(f"candidacy t={term}")
+        if crash_mid_sweep:
+            self.log(f"CRASH mid-candidacy t={term}")
+            self.crash()
+            return False
+        votes = reachable = 1  # self
+        for r in self.others():
+            if not r.reachable:
+                continue  # unreachable abstains (majority-of-responding)
+            out = r.on_vote(term, self.idx, self.seq, self.seq_term)
+            reachable += 1
+            if out["granted"]:
+                votes += 1
+            if out["term"] > term:
+                self.term = max(self.term, out["term"])
+                return False  # someone is ahead; follow them
+        if votes >= reachable // 2 + 1:
+            self._become_leader(term)
+            return True
+        self.term = max(self.term, term)
+        self.log(f"lost t={term} ({votes}/{reachable})")
+        return False
+
+    def _become_leader(self, term: int) -> None:
+        self.term = term
+        self.role = "leader"
+        self.world.record_leader(term, self.idx)
+        self.log(f"LEADER t={term}")
+        # takeover catch-up: full snapshot at the new term so every
+        # follower converges onto the new seq domain
+        self.push_state()
+
+    def step_down(self, term: int) -> None:
+        self.term = max(self.term, term)
+        if self.role == "leader":
+            self.role = "follower"
+            self.log(f"deposed at t={term}")
+
+    # -- crash / restart -----------------------------------------------------
+
+    def crash(self) -> None:
+        self.alive = False
+        self.role = "dead"
+        self.log("crash")
+
+    def restart(self) -> None:
+        """WAL replay, mirroring ``_recover_from_wal``/``wal.replay``."""
+        sp = self.spec
+        self.alive = True
+        self.unreachable = False
+        self.role = "follower"
+        self.term = self.wal.term
+        self.voted_term = self.wal.voted_term
+        if self.wal.snapshot is not None:
+            self.seq_term, self.seq, self.state = self.wal.snapshot
+        else:
+            self.seq = self.seq_term = 0
+            self.state = ()
+        log = list(self.wal.log)
+        if self.wal.torn and log:
+            if sp.truncate_torn_tail:
+                # torn tail truncated: the op was never acked, the
+                # clean prefix is the durable truth
+                log = log[:-1]
+                self.wal.log = list(log)
+                self.wal.torn = False
+                self.log("replay: torn tail truncated")
+            else:
+                # ABLATED: the torn record advances seq but its op
+                # bytes are unreadable — a corrupt projection
+                t, ops = log[-1]
+                log[-1] = (t, tuple((s, CORRUPT) for s, _ in ops))
+                self.log("replay: torn tail REPLAYED (ablated)")
+        for t, ops in log:
+            for s, op in ops:
+                if s > self.seq:
+                    self.state += (op,)
+                    self.seq = s
+                    self.seq_term = t
+        self.log(f"restart: replayed seq={self.seq} "
+                 f"dom={self.seq_term} t={self.term}")
+
+    # -- replication: leader side --------------------------------------------
+
+    def client_write(self, op: str, crash_after: Optional[int] = None,
+                     ) -> bool:
+        """One group-commit of one op, mirroring ``_on_mutation`` +
+        ``_commit``. ``crash_after`` kills the leader after that many
+        commit steps (0 = right after the local apply). Returns True
+        when the write was acked."""
+        sp = self.spec
+        w = self.world
+        w.issued.add(op)
+        if not self.alive or self.role != "leader":
+            return False
+        # local apply + seq assignment (the _mut_mu critical section)
+        self.seq += 1
+        self.seq_term = self.term
+        self.state += (op,)
+        batch = ((self.seq, op),)
+        term = self.term
+        acked = []
+
+        def do_wal():
+            self.wal.log.append((term, batch))
+            self.log(f"wal append {op}")
+
+        def do_push():
+            fenced = 0
+            for r in self.others():
+                if not r.reachable:
+                    self.log(f"push {op}: r{r.idx} unreachable, skipped")
+                    continue
+                out = r.on_apply_delta(term, self.idx, batch)
+                if out.get("status") == 409:
+                    fenced = max(fenced, out["term"])
+                elif out.get("gap"):
+                    self.push_snapshot_to(r)
+            return fenced
+
+        def do_ack():
+            w.acked.append(op)
+            acked.append(op)
+            self.log(f"ACK {op}")
+
+        # replicate-before-ack, log-then-replicate — or the ablated
+        # orders the incidents shipped with
+        if not sp.ack_after_replicate:
+            steps = [("ack", do_ack), ("wal", do_wal), ("push", do_push)]
+        elif sp.wal_before_push:
+            steps = [("wal", do_wal), ("push", do_push), ("ack", do_ack)]
+        else:
+            steps = [("push", do_push), ("ack", do_ack), ("wal", do_wal)]
+        for i, (name, step) in enumerate(steps):
+            fenced = step() if name == "push" else (step() or 0)
+            if name == "push" and fenced and sp.step_down_on_409:
+                # term fencing: we are deposed — fail, never ack
+                self.step_down(fenced)
+                self.log(f"write {op} failed (fenced t={fenced})")
+                return bool(acked)
+            if crash_after is not None and crash_after == i + 1:
+                self.log(f"CRASH after step '{name}' of {op}")
+                self.crash()
+                return bool(acked)
+        return bool(acked)
+
+    def torn_write(self, op: str) -> None:
+        """Crash DURING the WAL append of ``op``: the record's length
+        prefix landed, the payload did not. Never pushed, never
+        acked."""
+        self.world.issued.add(op)
+        self.seq += 1
+        self.seq_term = self.term
+        self.state += (op,)
+        self.wal.log.append((self.term, ((self.seq, op),)))
+        self.wal.torn = True
+        self.log(f"CRASH mid-append of {op} (torn tail)")
+        self.crash()
+
+    def push_state(self) -> None:
+        """Full-snapshot push to every follower (``_push_state``):
+        seq bump + snapshot, stamped atomically."""
+        if self.role != "leader" or not self.alive:
+            return
+        self.seq += 1
+        self.seq_term = self.term
+        stamp = (self.term, self.seq, self.state)
+        self.wal.save_snapshot(self.term, self.seq, self.state)
+        self.log(f"full push t={self.term} seq={self.seq}")
+        fenced = 0
+        for r in self.others():
+            if not r.reachable:
+                continue
+            out = r.on_apply(stamp[0], stamp[1], stamp[2], self.idx)
+            if out.get("status") == 409:
+                fenced = max(fenced, out["term"])
+        if fenced:
+            self.step_down(fenced)
+
+    def push_snapshot_to(self, r: "MReplica") -> None:
+        """Repair ONE follower (``_push_snapshot_to``) — exact stamp,
+        no bump."""
+        if self.role != "leader" or not r.reachable:
+            return
+        out = r.on_apply(self.seq_term, self.seq, self.state, self.idx)
+        if out.get("status") == 409:
+            self.step_down(out["term"])
+
+    def racing_full_push(self, op: str, to: "MReplica") -> None:
+        """A full-snapshot repair with a client write racing it — the
+        op-replay-non-idempotence shape. With the exact-stamp guard
+        (``_mut_mu``) the snapshot closes BEFORE the write applies;
+        ablated, the write slips inside the stamp window and the
+        follower replays it twice."""
+        sp = self.spec
+        w = self.world
+        w.issued.add(op)
+        self.seq += 1
+        self.seq_term = self.term
+        stamp_seq = self.seq
+        base_state = self.state
+
+        def apply_write():
+            self.seq += 1
+            self.state += (op,)
+            self.wal.log.append((self.term, ((self.seq, op),)))
+            w.acked.append(op)
+            self.log(f"ACK {op} (racing the snapshot)")
+
+        if sp.snapshot_stamp_exact:
+            snap_state = base_state  # stamped under _mut_mu: exact
+            apply_write()
+        else:  # ABLATED: the racing op is inside the stamped state
+            apply_write()
+            snap_state = self.state
+        to.on_apply(self.term, stamp_seq, snap_state, self.idx)
+        to.on_apply_delta(self.term, self.idx, ((self.seq, op),))
+
+    def heartbeat(self) -> None:
+        """One leader heartbeat round (``_heartbeat``): any follower
+        answering behind gets a full push."""
+        if self.role != "leader" or not self.alive:
+            return
+        behind = False
+        for r in self.others():
+            if not r.reachable:
+                continue
+            out = r.on_heartbeat(self.term, self.seq, self.idx)
+            if out.get("status") == 409:
+                self.step_down(out["term"])
+                return
+            if out.get("behind"):
+                behind = True
+        if behind:
+            self.push_state()
+
+    # -- replication: follower side ------------------------------------------
+
+    def on_apply_delta(self, term: int, leader: int,
+                       batch: Tuple[Tuple[int, str], ...]) -> Dict:
+        sp = self.spec
+        if sp.delta_term_fence and term < self.term:
+            return {"status": 409, "term": self.term}
+        self.term = term  # ablated fence: a stale push LOWERS the term
+        if self.role == "leader" and leader != self.idx:
+            self.role = "follower"
+        if sp.delta_domain_check and term != self.seq_term:
+            self.log(f"delta t={term}: gap (domain {self.seq_term})")
+            return {"gap": True, "seq": self.seq}
+        fresh = [(s, op) for s, op in batch if s > self.seq]
+        if not fresh:
+            return {"ok": True, "seq": self.seq}
+        run: List[Tuple[int, str]] = []
+        if sp.delta_contiguous:
+            expect = self.seq + 1
+            for s, op in fresh:
+                if s != expect:
+                    break  # a full-push bump consumed a seq
+                run.append((s, op))
+                expect += 1
+            if not run:
+                self.log(f"delta t={term}: gap (expect {self.seq + 1})")
+                return {"gap": True, "seq": self.seq}
+        else:  # ABLATED: holes replay silently
+            run = fresh
+        gap = len(run) < len(fresh)
+        for s, op in run:
+            self.state += (op,)
+            self.seq = s
+        if sp.delta_wal_append:
+            self.wal.log.append((term, tuple(run)))
+        self.log(f"delta t={term}: applied "
+                 f"{','.join(op for _, op in run)} seq={self.seq}")
+        if gap:
+            return {"gap": True, "seq": self.seq}
+        return {"ok": True, "seq": self.seq}
+
+    def on_apply(self, seq_term: int, seq: int, state: Tuple[str, ...],
+                 leader: int) -> Dict:
+        sp = self.spec
+        if sp.apply_term_fence and seq_term < self.term:
+            return {"status": 409, "term": self.term}
+        self.term = seq_term
+        if self.role == "leader" and leader != self.idx:
+            self.role = "follower"
+        if sp.apply_dup_guard and seq_term == self.seq_term \
+                and seq <= self.seq:
+            return {"ok": True, "seq": self.seq}  # ours is newer
+        self.seq = seq
+        self.seq_term = seq_term
+        self.state = state
+        self.wal.save_snapshot(seq_term, seq, state)
+        self.log(f"snapshot t={seq_term} seq={seq} adopted")
+        return {"ok": True, "seq": seq}
+
+    def on_heartbeat(self, term: int, seq: int, leader: int) -> Dict:
+        sp = self.spec
+        if term < self.term:
+            return {"status": 409, "term": self.term}
+        self.term = term
+        if self.role == "leader" and leader != self.idx:
+            self.role = "follower"
+        if sp.heartbeat_domain_behind:
+            # a seq from another domain is incomparable: behind until
+            # that leader's snapshot lands, whatever the numbers say
+            behind = self.seq_term != term or self.seq < seq
+        else:  # ABLATED: numeric compare only
+            behind = self.seq < seq
+        return {"behind": behind, "term": term}
+
+
+class World:
+    """The tier plus the god's-eye ledgers the invariants read."""
+
+    def __init__(self, n: int, spec: ConsensusSpec, scenario: str):
+        self.spec = spec
+        self.scenario = scenario
+        self.replicas = [MReplica(i, self) for i in range(n)]
+        self.leaders: Dict[int, set] = {}   # term -> replica idxs
+        self.votes: Dict[Tuple[int, int], set] = {}  # (voter, term)
+        self.acked: List[str] = []
+        self.issued: set = set()
+        self.history: List[str] = []
+        self.violations: List[Violation] = []
+
+    def log(self, ev: str) -> None:
+        self.history.append(ev)
+
+    def violate(self, invariant: str, detail: str) -> None:
+        self.violations.append(Violation(
+            invariant, self.scenario, detail, list(self.history)))
+
+    def record_leader(self, term: int, idx: int) -> None:
+        self.leaders.setdefault(term, set()).add(idx)
+        if len(self.leaders[term]) > 1:
+            self.violate(
+                "at-most-one-leader-per-term",
+                f"term {term} led by replicas "
+                f"{sorted(self.leaders[term])}")
+
+    def record_vote(self, voter: int, term: int, cand: int) -> None:
+        key = (voter, term)
+        self.votes.setdefault(key, set()).add(cand)
+        if len(self.votes[key]) > 1:
+            self.violate(
+                "no-double-vote",
+                f"r{voter} granted term {term} to candidates "
+                f"{sorted(self.votes[key])}")
+
+    # -- driving -------------------------------------------------------------
+
+    def elect_someone(self, order: List[int]) -> Optional[MReplica]:
+        """Candidacies in ``order`` until the tier has a leader —
+        the staggered-timeout election loop, with the stand-first
+        order made an explicit scenario parameter."""
+        for _ in range(2):  # a lost round retries at a higher term
+            for i in order:
+                r = self.replicas[i]
+                if r.reachable and r.run_election():
+                    return r
+        return None
+
+    def leader(self) -> Optional[MReplica]:
+        live = [r for r in self.replicas
+                if r.alive and r.role == "leader"]
+        if not live:
+            return None
+        return max(live, key=lambda r: r.term)
+
+    def settle(self) -> None:
+        """Heartbeat/repair rounds until the tier converges (bounded)."""
+        for _ in range(_SETTLE_ROUNDS):
+            led = self.leader()
+            if led is not None:
+                led.heartbeat()
+
+    # -- invariant sweep -----------------------------------------------------
+
+    def check(self) -> List[Violation]:
+        led = self.leader()
+        if led is not None:
+            for op in self.acked:
+                n = led.state.count(op)
+                if n == 0:
+                    self.violate(
+                        "every-acked-write-survives",
+                        f"acked op {op} missing from leader "
+                        f"r{led.idx}'s settled state {led.state}")
+                elif n > 1:
+                    self.violate(
+                        "every-acked-write-survives",
+                        f"acked op {op} applied {n}x on leader "
+                        f"r{led.idx} (replay is not idempotent)")
+            for r in self.replicas:
+                if not r.reachable:
+                    continue
+                if r is not led and r.state != led.state:
+                    self.violate(
+                        "seq-gap-freedom",
+                        f"r{r.idx} settled at {r.state}, leader "
+                        f"r{led.idx} at {led.state}")
+                for op in r.state:
+                    if op not in self.issued:
+                        self.violate(
+                            "seq-gap-freedom",
+                            f"r{r.idx} state holds {op!r}, which no "
+                            "client ever issued (corrupt replay)")
+                    elif r.state.count(op) > 1:
+                        self.violate(
+                            "seq-gap-freedom",
+                            f"r{r.idx} applied {op} "
+                            f"{r.state.count(op)}x")
+        return self.violations
+
+
+# -- scenarios ----------------------------------------------------------------
+#
+# Each scenario is (name, fn(spec, n) -> World-after-run). They are
+# deterministic compositions of the fault windows the tier claims to
+# survive; ``explore_consensus`` runs every scenario at every tier
+# size and sweeps the invariants.
+
+ScenarioFn = Callable[[ConsensusSpec, int], World]
+
+
+def s_election_race(spec: ConsensusSpec, n: int) -> World:
+    """Two candidacies racing for the SAME term: r1 misses r0's sweep
+    (transient loss) and stands at the term r0 already won."""
+    w = World(n, spec, f"election-race/n={n}")
+    w.replicas[1].unreachable = True
+    w.replicas[0].run_election()
+    w.replicas[1].unreachable = False
+    w.replicas[1].run_election()
+    w.settle()
+    w.check()
+    return w
+
+
+def s_voter_restart(spec: ConsensusSpec, n: int) -> World:
+    """A voter grants, crash-restarts, and is asked again at the SAME
+    term by a different candidate (PR 18 double-vote)."""
+    w = World(n, spec, f"voter-restart/n={n}")
+    if n >= 3:
+        w.replicas[2].unreachable = True  # r2 never hears term 1
+    w.replicas[1].run_election()  # r0 grants r1 term 1
+    w.replicas[0].crash()
+    w.replicas[0].restart()
+    if n >= 3:
+        w.replicas[2].unreachable = False
+        w.replicas[2].run_election()  # stands at term 1 again
+    else:
+        w.replicas[0].run_election()  # its own candidacy post-restart
+    w.settle()
+    w.check()
+    return w
+
+
+def s_candidacy_amnesia(spec: ConsensusSpec, n: int) -> World:
+    """A candidate self-votes, crashes before the sweep, restarts —
+    then another candidate asks for the same term."""
+    w = World(n, spec, f"candidacy-amnesia/n={n}")
+    w.replicas[0].run_election(crash_mid_sweep=True)
+    w.replicas[0].restart()
+    w.replicas[1].run_election()  # term 1 again; r0 must refuse
+    if w.leader() is None:
+        w.replicas[1].run_election()  # retry at a fresh term
+    w.settle()
+    w.check()
+    return w
+
+
+def s_commit_crash(spec: ConsensusSpec, n: int) -> World:
+    """The leader dies at every commit step of an in-flight write,
+    restarts, and the tier re-elects in every stand-first order."""
+    last = None
+    for crash_after in (1, 2, 3):
+        for first in range(n):
+            name = (f"commit-crash/n={n}/after-step-{crash_after}"
+                    f"/stands-first=r{first}")
+            w = World(n, spec, name)
+            w.elect_someone([0])
+            w.replicas[0].client_write("w1")
+            w.replicas[0].client_write("w2", crash_after=crash_after)
+            w.replicas[0].restart()
+            w.elect_someone([first] + [i for i in range(n)
+                                       if i != first])
+            w.settle()
+            w.check()
+            if w.violations:
+                return w
+            last = w
+    return last
+
+
+def s_unreachable_commit(spec: ConsensusSpec, n: int) -> World:
+    """ONE follower transiently drops the push window, the write acks
+    on the responding majority, then the leader crashes. The leader's
+    own WAL — written BEFORE the push — plus the §5.4.1 completeness
+    guard are all that stand between the acked op and oblivion.
+    (Exactly one fault window + one crash: making EVERY follower deaf
+    is a multi-fault run where majority-of-responding is documented
+    unsafe — see the module docstring's scope honesty note.)"""
+    last = None
+    for crash_after in (2, 3):
+        for first in range(n):
+            name = (f"unreachable-commit/n={n}/after-step-"
+                    f"{crash_after}/stands-first=r{first}")
+            w = World(n, spec, name)
+            w.elect_someone([0])
+            w.replicas[0].client_write("w1")
+            deaf = w.replicas[1]
+            deaf.unreachable = True
+            w.replicas[0].client_write("w2", crash_after=crash_after)
+            deaf.unreachable = False
+            w.replicas[0].restart()
+            w.elect_someone([first] + [i for i in range(n)
+                                       if i != first])
+            w.settle()
+            w.check()
+            if w.violations:
+                return w
+            last = w
+    return last
+
+
+def s_stale_leader(spec: ConsensusSpec, n: int) -> World:
+    """A deposed-but-unaware leader keeps pushing at its old term
+    (PR 16 incident): the followers' 409 fence must depose it before
+    it acks anything the new history will erase."""
+    w = World(n, spec, f"stale-leader/n={n}")
+    w.elect_someone([0])
+    w.replicas[0].client_write("w1")
+    # r0 goes transiently deaf; the rest elect a new leader and move on
+    w.replicas[0].unreachable = True
+    w.elect_someone([1])
+    w.replicas[1].client_write("v1")
+    w.replicas[0].unreachable = False
+    # a client still bound to r0 writes through the stale leader
+    w.replicas[0].client_write("w2")
+    w.settle()
+    w.check()
+    return w
+
+
+def s_domain_repair(spec: ConsensusSpec, n: int) -> World:
+    """PR 17 incident: a restarted replica rejoins with an OLD-term
+    seq numerically equal to the new leader's. Only the domain-aware
+    ``behind`` rule gets it repaired."""
+    w = World(n, spec, f"domain-repair/n={n}")
+    w.elect_someone([0])
+    w.replicas[0].client_write("w1")
+    # crash right after the WAL append: seq advanced on r0 alone
+    w.replicas[0].client_write("w2", crash_after=1)
+    w.elect_someone([1])  # new leader bumps onto a fresh seq domain
+    w.replicas[0].restart()
+    w.settle()
+    w.check()
+    return w
+
+
+def s_delta_gap(spec: ConsensusSpec, n: int) -> World:
+    """A follower misses one delta window; the next delta must answer
+    gap and trigger the snapshot repair, not replay around the hole."""
+    w = World(n, spec, f"delta-gap/n={n}")
+    w.elect_someone([0])
+    w.replicas[0].client_write("w1")
+    w.replicas[n - 1].unreachable = True
+    w.replicas[0].client_write("w2")
+    w.replicas[n - 1].unreachable = False
+    w.replicas[0].client_write("w3")
+    w.settle()
+    w.check()
+    return w
+
+
+def s_whole_tier(spec: ConsensusSpec, n: int) -> World:
+    """Whole-tier death and WAL rejoin, with and without a torn tail
+    on the old leader (PR 17/18 durable-control-plane shape)."""
+    last = None
+    for torn in (False, True):
+        for first in range(n):
+            name = (f"whole-tier/n={n}/torn={int(torn)}"
+                    f"/stands-first=r{first}")
+            w = World(n, spec, name)
+            w.elect_someone([0])
+            w.replicas[0].client_write("w1")
+            w.replicas[0].client_write("w2")
+            if torn:
+                w.replicas[0].torn_write("w3")
+            else:
+                w.replicas[0].crash()
+            for r in w.replicas[0].others():
+                r.crash()
+            for r in w.replicas:
+                r.restart()
+            w.elect_someone([first] + [i for i in range(n)
+                                       if i != first])
+            w.settle()
+            w.check()
+            if w.violations:
+                return w
+            last = w
+    return last
+
+
+def s_racing_snapshot(spec: ConsensusSpec, n: int) -> World:
+    """A snapshot repair racing a client write: the stamp must be
+    exact or the follower replays the racing op twice (PR 18
+    non-idempotent-replay shape)."""
+    w = World(n, spec, f"racing-snapshot/n={n}")
+    w.elect_someone([0])
+    straggler = w.replicas[n - 1]
+    straggler.unreachable = True
+    w.replicas[0].client_write("w1")
+    straggler.unreachable = False
+    w.replicas[0].racing_full_push("w2", to=straggler)
+    w.settle()
+    w.check()
+    return w
+
+
+SCENARIOS: List[Tuple[str, ScenarioFn]] = [
+    ("election-race", s_election_race),
+    ("voter-restart", s_voter_restart),
+    ("candidacy-amnesia", s_candidacy_amnesia),
+    ("commit-crash", s_commit_crash),
+    ("unreachable-commit", s_unreachable_commit),
+    ("stale-leader", s_stale_leader),
+    ("domain-repair", s_domain_repair),
+    ("delta-gap", s_delta_gap),
+    ("whole-tier", s_whole_tier),
+    ("racing-snapshot", s_racing_snapshot),
+]
+
+#: MUST-FIRE fixtures: incident name -> the spec ablation that revives
+#: it. ``explore_consensus(ablate(spec, name))`` must produce at least
+#: one violation — a fixture that stops firing means the model lost
+#: the scenario that catches the incident. (delta_domain_check,
+#: apply_term_fence, apply_dup_guard, delta_wal_append and
+#: term_persist_atomic are extracted and modeled but have no dedicated
+#: ablation: within the crash-only scope their failure shapes are
+#: subsumed by the contiguity/fence/completeness fixtures below.)
+ABLATIONS: Dict[str, Dict] = {
+    "vote-term-op": {"vote_term_op": ">="},
+    "double-vote": {"persist_before_grant": False},
+    "candidacy-amnesia": {"persist_before_sweep": False},
+    "vote-completeness": {"vote_log_position": False},
+    "ack-before-replicate": {"ack_after_replicate": False},
+    "wal-before-push": {"wal_before_push": False},
+    "stale-leader-409": {"step_down_on_409": False},
+    "delta-term-fence": {"delta_term_fence": False},
+    "delta-contiguity": {"delta_contiguous": False},
+    "seq-domain-repair": {"heartbeat_domain_behind": False},
+    "torn-tail": {"truncate_torn_tail": False},
+    "replay-idempotence": {"snapshot_stamp_exact": False},
+}
+
+
+def ablate(spec: ConsensusSpec, name: str) -> ConsensusSpec:
+    return dataclasses.replace(spec, **ABLATIONS[name])
+
+
+def explore_consensus(spec: ConsensusSpec,
+                      scope: Tuple[int, ...] = (2, 3)
+                      ) -> List[Violation]:
+    """Run every scenario at every tier size; return all violations."""
+    out: List[Violation] = []
+    for n in scope:
+        for _, fn in SCENARIOS:
+            out.extend(fn(spec, n).violations)
+    return out

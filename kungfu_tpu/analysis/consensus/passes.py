@@ -1,0 +1,372 @@
+"""Static passes for the replicated control plane's safety rules.
+
+Three rules the PR 16–18 consensus surface relies on, each pinned
+today by exactly one example test, promoted to whole-tree lint:
+
+- **ack-ordering**: a handler that logs a replicated mutation
+  (``_on_mutation``) must do it under ``_mut_mu`` (log order ==
+  application order), must KEEP the returned wait-callable, and must
+  not send a success reply that isn't dominated by a call to it —
+  replicate-before-ack is a dataflow property, and a new mutation
+  route added without the wait would ack writes a leader crash loses
+  (the PR 16 incident);
+- **term-fence**: a replica handler that reads a term out of a peer
+  message and then mutates consensus state must compare that term
+  against its own state FIRST — an unfenced handler lets a stale
+  leader rewrite a newer history (the 409 fence, PR 16);
+- **handler-exception-safety**: an HTTP handler class serving
+  keep-alive connections (``protocol_version = "HTTP/1.1"``) must
+  firewall every ``do_*`` entry with a broad except that still sends
+  a reply — an escaped exception kills the handler thread without a
+  response and the pooled client (peer.py keeps connections hot)
+  blocks on the dead read until its timeout. Plain HTTP/1.0 handlers
+  close the connection per request and are out of scope: the client
+  sees the close, not a hang.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..core import Finding, Source, dotted_name
+
+#: calls that count as sending something back on the wire
+_REPLY_CALLS = {"_reply", "send_error", "send_response"}
+
+
+def _own_scope(fn: ast.AST):
+    """Statements/expressions of ``fn`` excluding nested defs (each
+    function is analyzed in its own scope)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _strip_doc(body: List[ast.stmt]) -> List[ast.stmt]:
+    if body and isinstance(body[0], ast.Expr) \
+            and isinstance(body[0].value, ast.Constant) \
+            and isinstance(body[0].value.value, str):
+        return body[1:]
+    return body
+
+
+class AckOrderingPass:
+    name = "ack-ordering"
+    doc = ("mutation handlers whose success reply is not dominated by "
+           "the _on_mutation replication wait")
+
+    def run(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(src, node))
+        return findings
+
+    def _check_fn(self, src: Source, fn: ast.AST) -> List[Finding]:
+        # own-scope statements only; collect the _on_mutation calls,
+        # whether each is under a `with ..._mut_mu:`, which names bind
+        # their results, and every _reply site
+        muts: List[Tuple[ast.Call, bool]] = []
+        bound: set = set()
+        discarded: List[ast.AST] = []
+        wait_calls: List[int] = []
+        replies: List[ast.Call] = []
+
+        def walk(node: ast.AST, held: bool):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Attribute) \
+                            and ctx.attr == "_mut_mu":
+                        held = True
+            if isinstance(node, ast.Assign):
+                calls = [c for c in ast.walk(node.value)
+                         if self._is_mutation_call(c)]
+                if calls and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    bound.add(node.targets[0].id)
+            if isinstance(node, ast.Expr) \
+                    and self._is_mutation_call(node.value):
+                discarded.append(node)
+            if isinstance(node, ast.Call):
+                if self._is_mutation_call(node):
+                    muts.append((node, held))
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "_reply":
+                    replies.append(node)
+                if isinstance(f, ast.Name):
+                    wait_calls.append((f.id, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        walk(fn, False)
+        if not muts:
+            return []
+        findings: List[Finding] = []
+        for call, held in muts:
+            if not held:
+                f = src.finding(
+                    call, self.name,
+                    "replicated mutation logged outside "
+                    "'with ..._mut_mu:' — the delta log can record "
+                    "ops out of application order")
+                if f:
+                    findings.append(f)
+        for node in discarded:
+            f = src.finding(
+                node, self.name,
+                "replication wait-callable discarded — the handler "
+                "can never block on replicate-before-ack")
+            if f:
+                findings.append(f)
+        waited = sorted(ln for name, ln in wait_calls if name in bound)
+        first_mut = min(c.lineno for c, _ in muts)
+        for reply in replies:
+            if reply.lineno <= first_mut:
+                continue  # pre-mutation error answers
+            if self._is_error_reply(reply):
+                continue
+            if not any(ln < reply.lineno for ln in waited):
+                f = src.finding(
+                    reply, self.name,
+                    "success reply not dominated by the replication "
+                    "wait — a 200 here can ack a write the leader's "
+                    "death loses")
+                if f:
+                    findings.append(f)
+        return findings
+
+    @staticmethod
+    def _is_mutation_call(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_on_mutation")
+
+    @staticmethod
+    def _is_error_reply(call: ast.Call) -> bool:
+        if not call.args:
+            return False
+        a = call.args[0]
+        return isinstance(a, ast.Constant) and isinstance(a.value, int) \
+            and a.value >= 400
+
+
+class TermFencePass:
+    name = "term-fence"
+    doc = ("replica handlers that adopt a peer message's term without "
+           "comparing it against their own state first")
+
+    #: consensus state a message handler may only touch behind a fence
+    _STATE = {"term", "voted_term", "seq", "seq_term", "role",
+              "leader_base"}
+    _STATE_CALLS = {"state_restore", "_apply_op"}
+    _FENCE_ATTRS = {"term", "voted_term", "seq_term"}
+
+    def run(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(src, node))
+        return findings
+
+    def _check_fn(self, src: Source, fn: ast.AST) -> List[Finding]:
+        bindings = []
+        for n in _own_scope(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and self._reads_msg_term(n.value):
+                bindings.append((n.targets[0].id, n.lineno))
+        if not bindings:
+            return []
+        mutations = [n for n in _own_scope(fn) if self._mutates(n)]
+        if not mutations:
+            return []
+        first = min(n.lineno for n in mutations)
+        # handler shape only: the message term is read BEFORE state is
+        # touched. A sender reading the term out of a peer's 409 body
+        # after its own bump (_push_state) is not adopting anything.
+        req_names = {name for name, ln in bindings if ln < first}
+        if not req_names:
+            return []
+        for n in _own_scope(fn):
+            if isinstance(n, ast.Compare) and n.lineno < first \
+                    and self._fences(n, req_names):
+                return []
+        f = src.finding(
+            fn, self.name,
+            f"{fn.name} adopts a message term into replica state "
+            "without fencing it first (compare against "
+            "self.term/voted_term/seq_term before mutating — a stale "
+            "leader must get a 409, not a rewrite)")
+        return [f] if f else []
+
+    @staticmethod
+    def _reads_msg_term(node: ast.AST) -> bool:
+        """``...get("term", ...)`` or ``...["term"]`` anywhere under
+        ``node``."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "get" and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and n.args[0].value == "term":
+                return True
+            if isinstance(n, ast.Subscript) \
+                    and isinstance(n.slice, ast.Constant) \
+                    and n.slice.value == "term":
+                return True
+        return False
+
+    def _mutates(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" \
+                        and t.attr in self._STATE:
+                    return True
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in self._STATE_CALLS:
+            return True
+        return False
+
+    def _fences(self, cmp: ast.Compare, req_names: set) -> bool:
+        names = {n.id for n in ast.walk(cmp) if isinstance(n, ast.Name)}
+        attrs = {n.attr for n in ast.walk(cmp)
+                 if isinstance(n, ast.Attribute)
+                 and isinstance(n.value, ast.Name)
+                 and n.value.id == "self"}
+        return bool(names & req_names) and bool(attrs
+                                                & self._FENCE_ATTRS)
+
+
+class HandlerExceptionSafetyPass:
+    name = "handler-exception-safety"
+    doc = ("keep-alive HTTP handler entries a non-KfError exception "
+           "can escape, hanging the pooled client")
+
+    def run(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef) and self._in_scope(node):
+                findings.extend(self._check_class(src, node))
+        return findings
+
+    @staticmethod
+    def _in_scope(cls: ast.ClassDef) -> bool:
+        handler_base = any(
+            (dotted_name(b) or "").endswith("HTTPRequestHandler")
+            for b in cls.bases)
+        if not handler_base:
+            return False
+        # only keep-alive handlers: an HTTP/1.0 handler closes the
+        # connection per request, so the client sees EOF, not a hang
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "protocol_version"
+                            for t in stmt.targets) \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and stmt.value.value == "HTTP/1.1":
+                return True
+        return False
+
+    def _check_class(self, src: Source,
+                     cls: ast.ClassDef) -> List[Finding]:
+        methods: Dict[str, ast.AST] = {
+            s.name: s for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        entries: Dict[str, ast.AST] = {
+            name: m for name, m in methods.items()
+            if name.startswith("do_")}
+        # alias entries: `do_PUT = _do_update` points the verb at a
+        # sibling method, which becomes the real entry to check
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Name) \
+                    and stmt.value.id in methods:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) \
+                            and t.id.startswith("do_"):
+                        entries[stmt.value.id] = methods[stmt.value.id]
+                        entries.pop(t.id, None)
+        findings: List[Finding] = []
+        for name, fn in sorted(entries.items()):
+            if self._entry_safe(methods, fn):
+                continue
+            f = src.finding(
+                fn, self.name,
+                f"{cls.name}.{name}: a non-KfError exception can "
+                "escape this keep-alive handler entry without a "
+                "reply — the pooled client blocks on the dead read; "
+                "firewall the body with a broad except that answers "
+                "500 (or drops the connection)")
+            if f:
+                findings.append(f)
+        return findings
+
+    def _entry_safe(self, methods: Dict[str, ast.AST],
+                    fn: ast.AST) -> bool:
+        if self._is_firewall(methods, fn):
+            return True
+        body = _strip_doc(fn.body)
+        # thin wrapper: a single call into a sibling method that IS
+        # the firewall (the `self._crash_guard(self._get)` idiom)
+        if len(body) == 1 and isinstance(body[0], ast.Expr) \
+                and isinstance(body[0].value, ast.Call):
+            callee = body[0].value.func
+            if isinstance(callee, ast.Attribute) \
+                    and isinstance(callee.value, ast.Name) \
+                    and callee.value.id == "self" \
+                    and callee.attr in methods:
+                return self._is_firewall(methods,
+                                         methods[callee.attr])
+        return False
+
+    def _is_firewall(self, methods: Dict[str, ast.AST],
+                     fn: ast.AST) -> bool:
+        body = _strip_doc(fn.body)
+        if len(body) != 1 or not isinstance(body[0], ast.Try):
+            return False
+        return any(self._broad(h) and self._replies(methods, h)
+                   for h in body[0].handlers)
+
+    @staticmethod
+    def _broad(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        name = dotted_name(handler.type) or ""
+        return name.split(".")[-1] in ("Exception", "BaseException")
+
+    @staticmethod
+    def _replies(methods: Dict[str, ast.AST],
+                 handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)):
+                continue
+            if n.func.attr in _REPLY_CALLS:
+                return True
+            # one-level resolution through a same-class helper
+            if isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id == "self" \
+                    and n.func.attr in methods:
+                helper = methods[n.func.attr]
+                if any(isinstance(c, ast.Call)
+                       and isinstance(c.func, ast.Attribute)
+                       and c.func.attr in _REPLY_CALLS
+                       for c in ast.walk(helper)):
+                    return True
+        return False

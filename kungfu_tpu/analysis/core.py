@@ -169,6 +169,9 @@ PASS_SPECS = (
     ("protocol.schedule_purity", "SchedulePurityPass"),
     ("protocol.strategy_graph", "StrategyGraphPass"),
     ("protocol.lock_order", "LockOrderPass"),
+    ("consensus.passes", "AckOrderingPass"),
+    ("consensus.passes", "TermFencePass"),
+    ("consensus.passes", "HandlerExceptionSafetyPass"),
 )
 
 

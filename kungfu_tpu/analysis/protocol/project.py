@@ -227,6 +227,28 @@ class ProjectIndex:
             else:
                 self._walk_defs(path, src, child, cls, parent)
 
+    # -- anchored lookup -----------------------------------------------------
+
+    def method(self, name: str, cls: Optional[str] = None,
+               module_suffix: Optional[str] = None
+               ) -> Optional[FuncInfo]:
+        """The UNIQUE function named ``name`` — optionally narrowed to
+        an owning class and/or a module path suffix — or None when the
+        tree has zero or several matches. Extractors that lift a model
+        out of the code anchor on this and raise when it returns None:
+        a renamed or duplicated anchor must break the extraction
+        loudly, never silently bind a different function (the
+        bucket-template precedent, protocol/explore.py)."""
+        hits = []
+        for f in self.by_simple.get(name, ()):
+            if cls is not None and f.cls != cls:
+                continue
+            if module_suffix is not None and not f.module.replace(
+                    "\\", "/").endswith(module_suffix):
+                continue
+            hits.append(f)
+        return hits[0] if len(hits) == 1 else None
+
     # -- call resolution -----------------------------------------------------
 
     def resolve_call(self, call: ast.Call,

@@ -396,7 +396,39 @@ class ConfigServer:
                 self._reply(code, payload)
                 return True
 
+            def _crash_guard(self, fn):
+                """Exception firewall under every do_* entry: the
+                connection is keep-alive, so a handler thread that
+                dies WITHOUT a reply leaves the pooled client
+                (peer.py keeps these sockets hot) blocked on the dead
+                read until its timeout. Answer 500 if the wire is
+                still usable, else drop the connection so the client
+                at least sees EOF. Checked by handler-exception-safety."""
+                try:
+                    fn()
+                # top of the handler stack: nothing above can retry,
+                # and propagating would hang the keep-alive client
+                # kflint: disable=retry-discipline
+                except Exception as e:
+                    print(f"[kf-config-server] handler crashed on "
+                          f"{getattr(self, 'requestline', '?')}: {e!r}",
+                          flush=True)
+                    try:
+                        self._reply(500, json.dumps(
+                            {"error": f"internal error: {e}"}))
+                    except OSError:
+                        self.close_connection = True
+
             def do_GET(self):
+                self._crash_guard(self._get)
+
+            def _do_update(self):
+                self._crash_guard(self._update)
+
+            do_PUT = _do_update
+            do_POST = _do_update
+
+            def _get(self):
                 if self._intercepted("GET", ""):
                     return
                 if self.path.startswith("/trace"):
@@ -427,7 +459,7 @@ class ConfigServer:
                 else:
                     self._reply(404, '{"error": "unknown path"}')
 
-            def _do_update(self):
+            def _update(self):
                 body = self._body(self.command)
                 if self._intercepted(self.command, body):
                     return
@@ -491,9 +523,6 @@ class ConfigServer:
                                      ' (leader changed mid-commit)"}')
                 else:
                     self._reply(200, stage_body)
-
-            do_PUT = _do_update
-            do_POST = _do_update
 
         return Handler
 

@@ -189,9 +189,10 @@ class ReplicaConfigServer(ConfigServer):
         self.seq_term = 0       # kf: guarded_by(_rlock)
         self._hb_t = time.monotonic()  # kf: guarded_by(_rlock)
         #: index-aligned replica bases (self included); set by wire()
-        self.peers: List[str] = []
-        self.dead = False
+        self.peers: List[str] = []  # kf: guarded_by(_rlock)
+        self.dead = False           # kf: guarded_by(_rlock)
         #: KF_CP_MTTR anchors (epoch ms) of the most recent transition
+        # kf: guarded_by(_rlock)
         self.mttr_marks: Dict[str, float] = {}
         # serializes snapshot restores (decide-then-restore must not
         # interleave between two concurrent pushes)
@@ -201,19 +202,22 @@ class ReplicaConfigServer(ConfigServer):
         self._rng = random.Random(0xC0 + self.index)
         self._stop_monitor = threading.Event()
         self._monitor: Optional[threading.Thread] = None
-        self._unreachable: set = set()
+        self._unreachable: set = set()  # kf: guarded_by(_rlock)
         # pending delta-log entries awaiting the group-commit flush
         self._log_cv = threading.Condition()
         self._log: List[Dict] = []  # kf: guarded_by(_log_cv)
         self._committer: Optional[threading.Thread] = None
-        self.delta_batches = 0  # committed batches (stats/anti-entropy)
+        # committed batches (stats/anti-entropy)
+        self.delta_batches = 0  # kf: guarded_by(_rlock)
         # -- durable spine (elastic/wal.py): enabled iff a WAL dir is
         # configured; memory-only tiers (the pre-WAL default) stay
         # byte-identical in behavior
         root = wal_dir if wal_dir is not None \
             else os.environ.get("KF_CP_WAL_DIR", "")
         self._wal_root = root
-        self.wal: Optional[WriteAheadLog] = None
+        # the handle is swapped by reincarnate() while RPC threads
+        # run; the WAL's own _mu only guards its internals
+        self.wal: Optional[WriteAheadLog] = None  # kf: guarded_by(_rlock)
         if root:
             self.wal = WriteAheadLog(
                 os.path.join(root, f"replica-{self.index}"),
@@ -221,7 +225,7 @@ class ReplicaConfigServer(ConfigServer):
                 name=f"r{self.index}")
         self.wal_compact_ops = env_int("KF_CP_WAL_COMPACT_OPS", 512,
                                        minimum=8)
-        self.wal_replay_ms = 0.0
+        self.wal_replay_ms = 0.0  # kf: guarded_by(_rlock)
         if self.wal is not None:
             self._recover_from_wal()
 
@@ -250,7 +254,8 @@ class ReplicaConfigServer(ConfigServer):
             raise ValueError(
                 f"replica {self.index}: peers[{self.index}] is "
                 f"{bases[self.index]!r}, expected own base {self.base!r}")
-        self.peers = list(bases)
+        with self._rlock:
+            self.peers = list(bases)
         self._monitor = threading.Thread(
             target=self._monitor_loop, name=f"kf-replica-{self.index}",
             daemon=True)
@@ -265,8 +270,8 @@ class ReplicaConfigServer(ConfigServer):
         """Permanent death — the ``kill_config_replica`` contract:
         listener, monitor and role all gone, never restarted (distinct
         from the restart-shaped `_chaos_die`/`restart` pair)."""
-        self.dead = True
         with self._rlock:
+            self.dead = True
             self.role = "dead"
         self._stop_monitor.set()
         with self._log_cv:
@@ -279,8 +284,8 @@ class ReplicaConfigServer(ConfigServer):
         lingering one could race a later relaunch and kill the new
         listener). Unlike ``die()`` this is restartable: a subsequent
         ``reincarnate()`` replays the WAL and rejoins."""
-        self.dead = True
         with self._rlock:
+            self.dead = True
             self.role = "dead"
         self._stop_monitor.set()
         with self._log_cv:
@@ -331,15 +336,18 @@ class ReplicaConfigServer(ConfigServer):
             self.seq = 0
             self.seq_term = 0
             self._hb_t = time.monotonic()
-        self.mttr_marks = {}
-        self.delta_batches = 0
+            self.mttr_marks = {}
+            self.delta_batches = 0
+            # relaunch: fresh WAL handle (replayed below, outside the
+            # lock — _recover_from_wal takes _rlock itself)
+            self.wal = WriteAheadLog(self.wal.dir,
+                                     fsync=self.wal.fsync,
+                                     name=f"r{self.index}")
         with self._log_cv:
             self._log = []
-        self.dead = False
-        # relaunch: fresh WAL handle, replay, rebind, fresh threads
-        # (the retired ones saw the OLD stop event and exited)
-        self.wal = WriteAheadLog(self.wal.dir, fsync=self.wal.fsync,
-                                 name=f"r{self.index}")
+        with self._rlock:
+            self.dead = False
+        # fresh threads (the retired ones saw the OLD stop event)
         self._recover_from_wal()
         self._stop_monitor = threading.Event()
         self.restart()  # same-port rebind with retry
@@ -366,7 +374,7 @@ class ReplicaConfigServer(ConfigServer):
         with self._rlock:
             self.seq = rep.seq
             self.seq_term = rep.seq_term
-        self.wal_replay_ms = rep.replay_ms
+            self.wal_replay_ms = rep.replay_ms
         print(f"KF_CP_WAL_REPLAY replica={self.index} seq={rep.seq} "
               f"seq_term={rep.seq_term} term={rep.term} "
               f"ops={len(rep.ops)} torn_bytes={rep.torn_bytes} "
@@ -465,7 +473,8 @@ class ReplicaConfigServer(ConfigServer):
         # detect == first candidacy after the lease lapsed (takeover
         # MTTR phase 1); setdefault keeps the FIRST detection if the
         # election needs several rounds
-        self.mttr_marks.setdefault("detect", now_ms)
+        with self._rlock:
+            self.mttr_marks.setdefault("detect", now_ms)
         print(f"KF_CP_MTTR detect t={now_ms:.1f} replica={self.index} "
               f"term={term}", flush=True)
         from .. import trace
@@ -509,7 +518,8 @@ class ReplicaConfigServer(ConfigServer):
             self.role = "leader"
             self.leader_base = self.base
         now_ms = time.time() * 1e3
-        self.mttr_marks["elected"] = now_ms
+        with self._rlock:
+            self.mttr_marks["elected"] = now_ms
         print(f"KF_CP_MTTR elected t={now_ms:.1f} replica={self.index} "
               f"term={term}", flush=True)
         from .. import trace
@@ -526,7 +536,8 @@ class ReplicaConfigServer(ConfigServer):
         except _RPCReject:
             pass  # fenced already: _push_state stepped us down
         done_ms = time.time() * 1e3
-        self.mttr_marks["catchup_done"] = done_ms
+        with self._rlock:
+            self.mttr_marks["catchup_done"] = done_ms
         print(f"KF_CP_MTTR catchup_done t={done_ms:.1f} "
               f"replica={self.index} term={term} "
               f"renewed_leases={renewed}", flush=True)
@@ -653,12 +664,14 @@ class ReplicaConfigServer(ConfigServer):
             self._step_down(fenced)
             self._fail(batch)
             return
-        self.delta_batches += 1
+        with self._rlock:
+            self.delta_batches += 1
+            batches = self.delta_batches
         for entry in batch:
             entry["ok"] = True
             entry["ev"].set()
         self._wal_maybe_compact()
-        if self.delta_batches % _ANTI_ENTROPY_EVERY == 0:
+        if batches % _ANTI_ENTROPY_EVERY == 0:
             self._push_state()  # bound clock-replay drift (docstring)
 
     def _push_snapshot_to(self, i: int, peer_base: str) -> None:
@@ -755,14 +768,20 @@ class ReplicaConfigServer(ConfigServer):
             self._push_state()
 
     def _mark_unreachable(self, i: int) -> None:
-        if i not in self._unreachable:
-            self._unreachable.add(i)
+        with self._rlock:
+            flipped = i not in self._unreachable
+            if flipped:
+                self._unreachable.add(i)
+        if flipped:
             print(f"[kf-replica] r{self.index}: replica {i} "
                   "unreachable; continuing without it", flush=True)
 
     def _mark_reachable(self, i: int) -> None:
-        if i in self._unreachable:
-            self._unreachable.discard(i)
+        with self._rlock:
+            flipped = i in self._unreachable
+            if flipped:
+                self._unreachable.discard(i)
+        if flipped:
             print(f"[kf-replica] r{self.index}: replica {i} back",
                   flush=True)
 

@@ -135,7 +135,8 @@ class WriteAheadLog:
         self.name = name
         os.makedirs(wal_dir, exist_ok=True)
         self._mu = threading.RLock()
-        self._log: Optional[object] = None  # lazily opened append fd
+        # lazily opened append fd
+        self._log: Optional[object] = None  # kf: guarded_by(_mu)
         self.bytes_appended = 0
         self.records_appended = 0
         #: ops appended since the last snapshot (compaction trigger)
@@ -200,9 +201,12 @@ class WriteAheadLog:
     # -- append path ---------------------------------------------------------
 
     def _log_fd(self):
-        if self._log is None:
-            self._log = open(self.log_path, "ab")
-        return self._log
+        # every caller already holds _mu; the re-acquire is free
+        # (RLock) and keeps the guard lexical for lock-discipline
+        with self._mu:
+            if self._log is None:
+                self._log = open(self.log_path, "ab")
+            return self._log
 
     def append_batch(self, term: int, ops: List[Dict]) -> int:
         """Append ONE group-commit batch as ONE record and fsync ONCE
@@ -250,9 +254,11 @@ class WriteAheadLog:
             self.ops_since_snapshot = 0
 
     def _truncate_log(self) -> None:
-        if self._log is not None:
-            self._log.close()
-            self._log = None
+        # callers hold _mu; lexical re-acquire (RLock) as in _log_fd
+        with self._mu:
+            if self._log is not None:
+                self._log.close()
+                self._log = None
         with open(self.log_path, "wb") as f:
             if self.fsync:
                 f.flush()

@@ -78,14 +78,14 @@ class Router:
         self.standalone = standalone
         self.flush_ms = float(flush_ms) if flush_ms is not None else \
             env_float("KF_ROUTER_FLUSH_MS", 2.0, minimum=0.0)
-        self.dead = False
+        self.dead = False  # kf: guarded_by(_cv)
         self._cv = threading.Condition()
         # submit entries awaiting the coalesced flush
         self._pending: List[Dict] = []  # kf: guarded_by(_cv)
         self._reqs = 0  # kf: guarded_by(_cv) — chaos request counter
         self._upstream = 0  # kf: guarded_by(_cv) — last good server
-        self.flushed_batches = 0
-        self.submitted = 0
+        self.flushed_batches = 0  # kf: guarded_by(_cv)
+        self.submitted = 0        # kf: guarded_by(_cv)
         self._stop_flusher = threading.Event()
         self._lock = threading.Lock()
         # kf: guarded_by(_lock)
@@ -117,9 +117,9 @@ class Router:
         return self
 
     def stop(self) -> None:
-        self.dead = True
         self._stop_flusher.set()
         with self._cv:
+            self.dead = True
             self._cv.notify_all()
         with self._lock:
             httpd, self._httpd = self._httpd, None
@@ -212,11 +212,13 @@ class Router:
                       flush=True)
                 self._fail(batch)
                 continue
-            self.flushed_batches += 1
+            with self._cv:
+                self.flushed_batches += 1
+                self.submitted += sum(
+                    1 for r in results if "id" in r)
             for entry, res in zip(batch, results):
                 entry["out"] = res
                 entry["ev"].set()
-            self.submitted += sum(1 for r in results if "id" in r)
 
     @staticmethod
     def _fail(batch: List[Dict]) -> None:
@@ -300,7 +302,33 @@ class Router:
                     return
                 self._reply(200, json.dumps(doc))
 
+            def _crash_guard(self, fn):
+                """Exception firewall — see config_server.Handler:
+                keep-alive means an escaped exception hangs the pooled
+                client on a dead read. Checked by
+                handler-exception-safety."""
+                try:
+                    fn()
+                # top of the handler stack: nothing above can retry,
+                # and propagating would hang the keep-alive client
+                # kflint: disable=retry-discipline
+                except Exception as e:
+                    print(f"[kf-router] handler crashed on "
+                          f"{getattr(self, 'requestline', '?')}: {e!r}",
+                          flush=True)
+                    try:
+                        self._reply(500, json.dumps(
+                            {"error": f"internal error: {e}"}))
+                    except OSError:
+                        self.close_connection = True
+
             def do_GET(self):
+                self._crash_guard(self._get)
+
+            def do_POST(self):
+                self._crash_guard(self._post)
+
+            def _get(self):
                 from urllib.parse import parse_qs, urlparse
 
                 from kungfu_tpu.serve import frontend
@@ -341,7 +369,7 @@ class Router:
                     return
                 self._reply(404, '{"error": "not a router route"}')
 
-            def do_POST(self):
+            def _post(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n).decode() if n else ""
                 if self._chaos():

@@ -23,6 +23,14 @@ echo "== [0/7] lint: kflint + kfverify (+ruff/mypy when available) =="
 # stricter pass lands with debt.)
 JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis kungfu_tpu/ \
   --baseline scripts/kflint_baseline.json
+# the consensus gate (docs/static_analysis.md "The consensus
+# checker"): extract the election/replication machine out of
+# replica.py/wal.py (raises on drift), prove the four invariants over
+# every 2-3-replica interleaving, and require all 12 incident-shaped
+# MUST-FIRE ablations to diverge — through the same stable-ID
+# baseline discipline as kflint above
+JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis.consensus \
+  --baseline scripts/kfconsensus_baseline.json
 # every round must publish its headline metric (BENCH_rNN.json); a
 # round that only touched BASELINE.json leaves the perf-trajectory
 # feed blind — fail loudly and early (benchmarks/publish.py)

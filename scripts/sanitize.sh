@@ -65,6 +65,41 @@ for flavor in ${FLAVORS[@]+"${FLAVORS[@]}"}; do
       || { echo "SANITIZE FAILED: $flavor round $round"; exit 1; }
     port=$((port + 16))
   done
+
+  if [ "$flavor" = tsan ]; then
+    # Python-side TSan round: the C++ driver above exercises the
+    # native threads, but never the combination the real system runs
+    # — CPython replica threads (committer/heartbeat/election/HTTP
+    # handlers) interleaving with ffi calls into the instrumented
+    # library. Preload the shared TSan runtime into an uninstrumented
+    # CPython (the standard sanitize-an-extension recipe: only
+    # libkf_tsan.so frames and intercepted libc/pthread calls are
+    # observed) and drive the in-process ReplicaTier election/commit
+    # smoke + a threaded 2-peer native allreduce.
+    echo "-- tsan python round: ReplicaTier election/commit smoke" \
+         "(base port $port)"
+    LIBTSAN="$(${CXX:-g++} -print-file-name=libtsan.so 2>/dev/null || true)"
+    if [ ! -f "${LIBTSAN:-}" ]; then
+      LIBTSAN="$(/sbin/ldconfig -p 2>/dev/null \
+                 | awk '/libtsan\.so/{print $NF; exit}')"
+    fi
+    if [ -f "${LIBTSAN:-}" ]; then
+      make -C "$NATIVE" tsan
+      LD_PRELOAD="$LIBTSAN" \
+      KF_LIB="$PWD/$NATIVE/libkf_tsan.so" \
+      TSAN_OPTIONS="halt_on_error=1:suppressions=$PWD/$NATIVE/sanitize/tsan.supp" \
+      KF_SMOKE_BASE_PORT=$port JAX_PLATFORMS=cpu \
+      PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+      timeout 580 python scripts/tsan-replica-smoke.py \
+        || { echo "SANITIZE FAILED: tsan python round"; exit 1; }
+      port=$((port + 16))
+    else
+      # loud skip, never silent: the round needs the SHARED TSan
+      # runtime to preload into CPython
+      echo "   SKIPPED: libtsan.so not found (need the shared TSan" \
+           "runtime to preload into CPython)"
+    fi
+  fi
 done
 
 echo "SANITIZE GREEN ([tidy=$TIDY] ${FLAVORS[*]-} x $ROUNDS rounds)"

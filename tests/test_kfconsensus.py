@@ -1,0 +1,380 @@
+"""kfconsensus: the consensus surface's verification layer.
+
+Four layers under test, mirroring docs/static_analysis.md:
+
+- the **extractor** lifts the real election/replication guards out of
+  ``elastic/replica.py`` + ``elastic/wal.py`` (every guard present,
+  vote op strict) and RAISES when the code drifts from the shapes it
+  matches — a model that silently diverged proves nothing;
+- the **model checker** upholds all four invariants over the full
+  2–3-replica scope, and every MUST-FIRE ablation (one guard removed:
+  the PR 16/17/18 incident shapes) produces a divergence trace;
+- the **three static passes** (ack-ordering, term-fence,
+  handler-exception-safety) fire on the hazard shapes and stay quiet
+  on the tree's real idioms;
+- the **CLI** mirrors kflint's stable-ID/baseline contract.
+
+Plus the WAL crash-window edge the model exercises symbolically:
+vote persisted (meta.json ``os.replace`` done), op lost (log append
+never ran) — the rejoin must answer ``behind`` and must not re-vote.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kungfu_tpu.analysis.consensus import (ABLATIONS, ablate,
+                                           AckOrderingPass,
+                                           HandlerExceptionSafetyPass,
+                                           TermFencePass,
+                                           consensus_paths,
+                                           default_spec,
+                                           explore_consensus,
+                                           extract_consensus_spec)
+from kungfu_tpu.analysis.core import Source, run_source
+from kungfu_tpu.analysis.protocol.project import ProjectIndex
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fire(pass_obj, src):
+    return run_source(pass_obj, textwrap.dedent(src))
+
+
+# -- extractor ---------------------------------------------------------------
+
+
+def test_extractor_lifts_every_guard_from_the_real_tree():
+    spec = default_spec()
+    assert spec.vote_term_op == ">"  # strict: no re-vote at own term
+    for f in dataclasses.fields(spec):
+        if f.type is bool or isinstance(getattr(spec, f.name), bool):
+            assert getattr(spec, f.name) is True, \
+                f"extractor lost the {f.name} guard"
+
+
+def test_extractor_raises_on_vote_guard_drift():
+    # the explore.py bucket-name-template precedent: weaken the vote
+    # guard in a COPY of replica.py and the extractor must refuse to
+    # produce a spec rather than model the wrong machine
+    paths = consensus_paths()
+    srcs = {}
+    for p in paths:
+        with open(p, encoding="utf-8") as fh:
+            text = fh.read()
+        if p.endswith("replica.py"):
+            want = "granted = req_term > max(self.term, self.voted_term)"
+            assert want in text  # the shape the extractor anchors on
+            text = text.replace(
+                want, "granted = req_term >= self.term")
+        srcs[p] = Source.parse(p, text)
+    with pytest.raises(ValueError, match="drifted"):
+        extract_consensus_spec(ProjectIndex(srcs))
+
+
+# -- model checker: must-hold ------------------------------------------------
+
+
+def test_all_four_invariants_hold_over_full_small_scope():
+    violations = explore_consensus(default_spec(), scope=(2, 3))
+    assert violations == [], violations[0].trace()
+
+
+# -- model checker: must-fire ablations --------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(ABLATIONS))
+def test_ablation_must_fire(name):
+    violations = explore_consensus(ablate(default_spec(), name),
+                                   scope=(2, 3))
+    assert violations, \
+        f"ablation {name!r} produced no divergence — the model " \
+        "lost the hazard this guard exists for"
+    trace = violations[0].trace()
+    assert "invariant violated" in trace
+    assert "history:" in trace  # the step-by-step incident replay
+
+
+def test_torn_tail_ablation_propagates_corrupt_replay():
+    # PR 18 incident shape: without truncation the torn record
+    # replays as an op no client ever issued
+    violations = explore_consensus(
+        ablate(default_spec(), "torn-tail"), scope=(2, 3))
+    assert any("⊥" in v.detail for v in violations)
+
+
+def test_double_vote_ablation_elects_two_leaders():
+    violations = explore_consensus(
+        ablate(default_spec(), "double-vote"), scope=(2, 3))
+    assert any(v.invariant == "at-most-one-leader-per-term"
+               or v.invariant == "no-double-vote"
+               for v in violations)
+
+
+def test_ack_before_replicate_ablation_loses_acked_write():
+    # PR 16 incident shape: 200 sent before the push means a leader
+    # crash right after the ack loses the write
+    violations = explore_consensus(
+        ablate(default_spec(), "ack-before-replicate"), scope=(2, 3))
+    assert any(v.invariant == "every-acked-write-survives"
+               for v in violations)
+
+
+def test_unknown_ablation_rejected():
+    with pytest.raises(KeyError):
+        ablate(default_spec(), "no-such-guard")
+
+
+# -- WAL crash window: vote persisted, op lost (satellite) -------------------
+
+
+def test_wal_crash_between_meta_replace_and_log_append(tmp_path):
+    from kungfu_tpu.elastic.replica import ReplicaConfigServer
+    from kungfu_tpu.elastic.wal import WriteAheadLog
+
+    wal = WriteAheadLog(os.path.join(str(tmp_path), "replica-0"),
+                        fsync=False, name="r0")
+    wal.append_batch(1, [{"seq": 1, "kind": "kf-test", "op": {}},
+                         {"seq": 2, "kind": "kf-test", "op": {}}])
+    # term 2's candidate asked for our vote: save_term's os.replace
+    # completed (the vote is durable) and we crashed before term 2's
+    # first delta ever reached the log — vote persisted, op lost
+    wal.save_term(2, 2)
+    wal.close()
+
+    r = ReplicaConfigServer(port=0, index=0, wal_dir=str(tmp_path))
+    try:
+        # the replay adopts the vote AND the pre-crash log position:
+        # seq 2 in term 1's domain, not a projection of term 2
+        assert (r.term, r.voted_term) == (2, 2)
+        assert (r.seq, r.seq_term) == (2, 1)
+        # term 2's leader heartbeats at seq 3: the old-domain seq is
+        # incomparable, so the rejoin must answer `behind` (and get
+        # the full snapshot) — NOT serve its stale projection as fresh
+        code, body = r._on_heartbeat(
+            {"term": 2, "seq": 3, "leader": "http://peer:1"})
+        assert code == 200
+        assert json.loads(body)["behind"] is True
+        # and the durable vote survives: no second grant at term 2
+        code, body = r._on_vote(
+            {"term": 2, "candidate": 1, "base": "http://peer:1",
+             "seq": 99, "seq_term": 2})
+        assert code == 200
+        assert json.loads(body)["granted"] is False
+    finally:
+        r.wal.close()
+
+
+# -- ack-ordering pass -------------------------------------------------------
+
+
+def test_ack_ordering_fires_on_unlocked_mutation():
+    findings = fire(AckOrderingPass(), """
+        class H:
+            def _do(self, body):
+                wait = server._on_mutation("stage", {"body": body})
+                if wait is not None and not wait():
+                    self._reply(503, "{}")
+                    return
+                self._reply(200, "{}")
+    """)
+    assert len(findings) == 1
+    assert "outside" in findings[0].message
+
+
+def test_ack_ordering_fires_on_discarded_wait():
+    findings = fire(AckOrderingPass(), """
+        class H:
+            def _do(self, body):
+                with server._mut_mu:
+                    server._on_mutation("stage", {"body": body})
+                self._reply(200, "{}")
+    """)
+    assert any("discarded" in f.message for f in findings)
+
+
+def test_ack_ordering_fires_on_unwaited_success_reply():
+    # PR 16 regression shape: the wait is kept but never consulted
+    # before the 200 — an acked write the leader's death loses
+    findings = fire(AckOrderingPass(), """
+        class H:
+            def _do(self, body):
+                with server._mut_mu:
+                    wait = server._on_mutation("stage", {"body": body})
+                self._reply(200, "{}")
+    """)
+    assert len(findings) == 1
+    assert "not dominated" in findings[0].message
+
+
+def test_ack_ordering_quiet_on_the_replicate_then_ack_idiom():
+    findings = fire(AckOrderingPass(), """
+        class H:
+            def _do(self, body):
+                out = parse(body)
+                if out is None:
+                    self._reply(400, "{}")
+                    return
+                with server._mut_mu:
+                    applied = apply_op(out)
+                    wait = None
+                    if applied:
+                        wait = server._on_mutation("stage",
+                                                   {"body": body})
+                if wait is not None and not wait():
+                    self._reply(503, "{}")
+                    return
+                self._reply(200, "{}")
+    """)
+    assert findings == []
+
+
+# -- term-fence pass ---------------------------------------------------------
+
+
+def test_term_fence_fires_on_unfenced_adoption():
+    findings = fire(TermFencePass(), """
+        class R:
+            def _on_push(self, msg):
+                t = int(msg.get("term", 0))
+                self.term = t
+                self.leader_base = msg.get("leader", "")
+    """)
+    assert len(findings) == 1
+    assert "without fencing" in findings[0].message
+
+
+def test_term_fence_quiet_when_compared_first():
+    findings = fire(TermFencePass(), """
+        class R:
+            def _on_push(self, msg):
+                t = int(msg.get("term", 0))
+                if t < self.term:
+                    return (409, "{}")
+                self.term = t
+    """)
+    assert findings == []
+
+
+def test_term_fence_quiet_on_sender_reading_reject_body():
+    # the _push_state shape: the 409 body's term is read AFTER our
+    # own bump — a sender consuming a rejection, not a handler
+    # adopting a message
+    findings = fire(TermFencePass(), """
+        class R:
+            def _push(self):
+                self.seq += 1
+                fenced = 0
+                for peer in self.peers:
+                    out = rpc(peer)
+                    if out.get("status") == 409:
+                        fenced = max(fenced, out.get("term", 0))
+                if fenced:
+                    self._step_down(fenced)
+    """)
+    assert findings == []
+
+
+# -- handler-exception-safety pass -------------------------------------------
+
+
+def test_handler_safety_fires_on_unguarded_keepalive_entry():
+    findings = fire(HandlerExceptionSafetyPass(), """
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                self._reply(200, work(self.path))
+    """)
+    assert len(findings) == 1
+    assert "do_GET" in findings[0].message
+
+
+def test_handler_safety_follows_do_verb_aliases():
+    findings = fire(HandlerExceptionSafetyPass(), """
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _update(self):
+                self._reply(200, work(self.path))
+
+            do_PUT = _update
+            do_POST = _update
+    """)
+    assert len(findings) == 1
+    assert "_update" in findings[0].message
+
+
+def test_handler_safety_quiet_on_firewalled_entries():
+    findings = fire(HandlerExceptionSafetyPass(), """
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _crash_guard(self, fn):
+                try:
+                    fn()
+                except Exception as e:
+                    try:
+                        self._reply(500, str(e))
+                    except OSError:
+                        self.close_connection = True
+
+            def do_GET(self):
+                self._crash_guard(self._get)
+
+            def _get(self):
+                self._reply(200, work(self.path))
+    """)
+    assert findings == []
+
+
+def test_handler_safety_ignores_http10_handlers():
+    # HTTP/1.0 closes the connection per request: the client sees
+    # EOF, not a hang — out of scope by design
+    findings = fire(HandlerExceptionSafetyPass(), """
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self._reply(200, work(self.path))
+    """)
+    assert findings == []
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def _cli(*args, timeout=420):
+    return subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.analysis.consensus",
+         *args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_cli_list_names_every_ablation():
+    r = _cli("--list", timeout=120)
+    assert r.returncode == 0, r.stderr
+    for name in ABLATIONS:
+        assert name in r.stdout
+
+
+def test_cli_gate_is_clean_against_committed_baseline():
+    r = _cli("--baseline", "scripts/kfconsensus_baseline.json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "12/12 ablations fired" in r.stderr
+
+
+def test_cli_show_prints_an_incident_trace():
+    r = _cli("--show", "stale-leader-409")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "invariant violated" in r.stdout
+    assert "history:" in r.stdout
+
+
+def test_cli_rejects_out_of_scope_replica_counts():
+    r = _cli("--scope", "5", timeout=120)
+    assert r.returncode == 2

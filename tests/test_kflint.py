@@ -1814,7 +1814,9 @@ def test_pass_registry_names_are_unique_and_complete():
                           "shard-rules", "shard-rule-coverage",
                           "shard-rule-mesh",
                           "wire-name-determinism", "collective-order",
-                          "schedule-purity", "lock-order"}
+                          "schedule-purity", "lock-order",
+                          "ack-ordering", "term-fence",
+                          "handler-exception-safety"}
 
 
 def test_cli_list_shows_every_registered_pass():
